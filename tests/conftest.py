@@ -1,0 +1,15 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any jax import (hence top-level in conftest). Real-TPU
+execution is exercised by bench.py / the driver, not the unit suite
+(SURVEY.md §4: deterministic in-process testing is the primary harness).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,588 @@
+"""Scenario tests for the oracle state machine.
+
+Scenarios adapted from the reference's state machine unit tests
+(reference: src/state_machine.zig test suite — create_accounts/create_transfers
+result codes, linked chains, two-phase commits, balancing transfers).
+"""
+
+from tigerbeetle_tpu.constants import NS_PER_S, U64_MAX, U128_MAX
+from tigerbeetle_tpu.models.oracle import OracleStateMachine
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFlags,
+    CreateAccountResult as AR,
+    CreateTransferResult as TR,
+    Operation,
+    Transfer,
+    TransferFlags as F,
+)
+
+LEDGER = 7
+
+
+def make_machine(n_accounts=4, flags=(0, 0, 0, 0), ledgers=None):
+    sm = OracleStateMachine()
+    accounts = [
+        Account(id=i + 1, ledger=(ledgers[i] if ledgers else LEDGER), code=1,
+                flags=flags[i] if i < len(flags) else 0)
+        for i in range(n_accounts)
+    ]
+    codes = sm.execute_dense(Operation.create_accounts, 100, accounts)
+    assert codes == [0] * n_accounts
+    return sm
+
+
+def run_transfers(sm, transfers, timestamp=10_000):
+    return sm.execute_dense(Operation.create_transfers, timestamp, transfers)
+
+
+# --- create_accounts ---
+
+
+def test_create_account_validation_precedence():
+    sm = OracleStateMachine()
+    cases = [
+        (Account(id=1, ledger=1, code=1, reserved=5), AR.reserved_field),
+        (Account(id=1, ledger=1, code=1, flags=1 << 5), AR.reserved_flag),
+        (Account(id=0, ledger=1, code=1), AR.id_must_not_be_zero),
+        (Account(id=U128_MAX, ledger=1, code=1), AR.id_must_not_be_int_max),
+        (Account(id=1, ledger=1, code=1, flags=6), AR.flags_are_mutually_exclusive),
+        (Account(id=1, ledger=1, code=1, debits_pending=1), AR.debits_pending_must_be_zero),
+        (Account(id=1, ledger=1, code=1, debits_posted=1), AR.debits_posted_must_be_zero),
+        (Account(id=1, ledger=1, code=1, credits_pending=1), AR.credits_pending_must_be_zero),
+        (Account(id=1, ledger=1, code=1, credits_posted=1), AR.credits_posted_must_be_zero),
+        (Account(id=1, ledger=0, code=1), AR.ledger_must_not_be_zero),
+        (Account(id=1, ledger=1, code=0), AR.code_must_not_be_zero),
+        # precedence: reserved_field beats id checks
+        (Account(id=0, ledger=0, code=0, reserved=9), AR.reserved_field),
+    ]
+    events = [c[0] for c in cases]
+    codes = sm.execute_dense(Operation.create_accounts, 50, events)
+    assert codes == [int(c[1]) for c in cases]
+
+
+def test_create_account_exists_codes():
+    sm = OracleStateMachine()
+    base = Account(id=9, ledger=1, code=2, user_data_128=5, user_data_64=6, user_data_32=7)
+    import dataclasses as dc
+
+    variants = [
+        dc.replace(base),
+        dc.replace(base, flags=int(AccountFlags.debits_must_not_exceed_credits)),
+        dc.replace(base, user_data_128=0),
+        dc.replace(base, user_data_64=0),
+        dc.replace(base, user_data_32=0),
+        dc.replace(base, ledger=3),
+        dc.replace(base, code=3),
+        dc.replace(base),
+    ]
+    codes = sm.execute_dense(Operation.create_accounts, 50, variants)
+    assert codes == [
+        0,
+        AR.exists_with_different_flags,
+        AR.exists_with_different_user_data_128,
+        AR.exists_with_different_user_data_64,
+        AR.exists_with_different_user_data_32,
+        AR.exists_with_different_ledger,
+        AR.exists_with_different_code,
+        AR.exists,
+    ]
+    assert sm.accounts[9].timestamp == 50 - 8 + 1  # first event's timestamp
+
+
+def test_account_timestamps_assigned_per_event():
+    sm = OracleStateMachine()
+    events = [Account(id=i + 1, ledger=1, code=1) for i in range(3)]
+    sm.execute_dense(Operation.create_accounts, 1000, events)
+    assert [sm.accounts[i + 1].timestamp for i in range(3)] == [998, 999, 1000]
+
+
+def test_account_timestamp_must_be_zero():
+    sm = OracleStateMachine()
+    codes = sm.execute_dense(
+        Operation.create_accounts, 10, [Account(id=1, ledger=1, code=1, timestamp=5)]
+    )
+    assert codes == [AR.timestamp_must_be_zero]
+
+
+# --- create_transfers: validation ---
+
+
+def test_create_transfer_validation_codes():
+    sm = make_machine()
+    t = lambda **kw: Transfer(
+        id=kw.pop("id", 100),
+        debit_account_id=kw.pop("dr", 1),
+        credit_account_id=kw.pop("cr", 2),
+        amount=kw.pop("amount", 10),
+        ledger=kw.pop("ledger", LEDGER),
+        code=kw.pop("code", 1),
+        **kw,
+    )
+    cases = [
+        (t(flags=1 << 7), TR.reserved_flag),
+        (t(id=0), TR.id_must_not_be_zero),
+        (t(id=U128_MAX), TR.id_must_not_be_int_max),
+        (t(dr=0), TR.debit_account_id_must_not_be_zero),
+        (t(dr=U128_MAX), TR.debit_account_id_must_not_be_int_max),
+        (t(cr=0), TR.credit_account_id_must_not_be_zero),
+        (t(cr=U128_MAX), TR.credit_account_id_must_not_be_int_max),
+        (t(cr=1), TR.accounts_must_be_different),
+        (t(pending_id=5), TR.pending_id_must_be_zero),
+        (t(timeout=5), TR.timeout_reserved_for_pending_transfer),
+        (t(amount=0), TR.amount_must_not_be_zero),
+        (t(ledger=0), TR.ledger_must_not_be_zero),
+        (t(code=0), TR.code_must_not_be_zero),
+        (t(dr=999), TR.debit_account_not_found),
+        (t(cr=999), TR.credit_account_not_found),
+        (t(ledger=LEDGER + 1), TR.transfer_must_have_the_same_ledger_as_accounts),
+        (t(id=101), TR.ok),
+    ]
+    codes = run_transfers(sm, [c[0] for c in cases])
+    assert codes == [int(c[1]) for c in cases]
+    assert sm.accounts[1].debits_posted == 10
+    assert sm.accounts[2].credits_posted == 10
+
+
+def test_accounts_must_have_same_ledger():
+    sm = make_machine(ledgers=[1, 2, 1, 1])
+    codes = run_transfers(
+        sm, [Transfer(id=50, debit_account_id=1, credit_account_id=2, amount=1,
+                      ledger=1, code=1)]
+    )
+    assert codes == [TR.accounts_must_have_the_same_ledger]
+
+
+def test_transfer_exists_codes():
+    sm = make_machine()
+    base = Transfer(id=70, debit_account_id=1, credit_account_id=2, amount=9,
+                    ledger=LEDGER, code=3, user_data_64=4)
+    import dataclasses as dc
+
+    batch = [
+        base,
+        dc.replace(base, flags=int(F.pending)),
+        dc.replace(base, debit_account_id=3),
+        dc.replace(base, credit_account_id=3),
+        dc.replace(base, amount=8),
+        dc.replace(base, user_data_128=1),
+        dc.replace(base, user_data_64=1),
+        dc.replace(base, user_data_32=1),
+        dc.replace(base, code=9),
+        dc.replace(base),
+    ]
+    codes = run_transfers(sm, batch)
+    assert codes == [
+        0,
+        TR.exists_with_different_flags,
+        TR.exists_with_different_debit_account_id,
+        TR.exists_with_different_credit_account_id,
+        TR.exists_with_different_amount,
+        TR.exists_with_different_user_data_128,
+        TR.exists_with_different_user_data_64,
+        TR.exists_with_different_user_data_32,
+        TR.exists_with_different_code,
+        TR.exists,
+    ]
+    # exists does not double-apply balances
+    assert sm.accounts[1].debits_posted == 9
+
+
+def test_exists_with_different_timeout():
+    sm = make_machine()
+    p = Transfer(id=70, debit_account_id=1, credit_account_id=2, amount=9,
+                 ledger=LEDGER, code=3, flags=int(F.pending), timeout=10)
+    import dataclasses as dc
+
+    codes = run_transfers(sm, [p, dc.replace(p, timeout=11)])
+    assert codes == [0, TR.exists_with_different_timeout]
+
+
+# --- two-phase ---
+
+
+def test_two_phase_post_full():
+    sm = make_machine()
+    pend = Transfer(id=1000, debit_account_id=1, credit_account_id=2, amount=50,
+                    ledger=LEDGER, code=1, flags=int(F.pending))
+    assert run_transfers(sm, [pend], timestamp=10_000) == [0]
+    assert sm.accounts[1].debits_pending == 50
+    assert sm.accounts[2].credits_pending == 50
+
+    post = Transfer(id=1001, pending_id=1000, amount=0,
+                    flags=int(F.post_pending_transfer))
+    assert run_transfers(sm, [post], timestamp=20_000) == [0]
+    a1, a2 = sm.accounts[1], sm.accounts[2]
+    assert (a1.debits_pending, a1.debits_posted) == (0, 50)
+    assert (a2.credits_pending, a2.credits_posted) == (0, 50)
+    e = sm.transfers[1001]
+    assert e.amount == 50
+    assert e.debit_account_id == 1 and e.credit_account_id == 2
+    assert e.ledger == LEDGER and e.code == 1
+    assert sm.posted[sm.transfers[1000].timestamp] == 1
+
+
+def test_two_phase_post_partial_and_errors():
+    sm = make_machine()
+    pend = Transfer(id=1000, debit_account_id=1, credit_account_id=2, amount=50,
+                    ledger=LEDGER, code=1, flags=int(F.pending))
+    run_transfers(sm, [pend], timestamp=10_000)
+
+    cases = [
+        (Transfer(id=1, pending_id=1000, flags=int(F.post_pending_transfer | F.void_pending_transfer)),
+         TR.flags_are_mutually_exclusive),
+        (Transfer(id=1, pending_id=1000, flags=int(F.post_pending_transfer | F.pending)),
+         TR.flags_are_mutually_exclusive),
+        (Transfer(id=1, pending_id=0, flags=int(F.post_pending_transfer)),
+         TR.pending_id_must_not_be_zero),
+        (Transfer(id=1, pending_id=U128_MAX, flags=int(F.post_pending_transfer)),
+         TR.pending_id_must_not_be_int_max),
+        (Transfer(id=1, pending_id=1, flags=int(F.post_pending_transfer)),
+         TR.pending_id_must_be_different),
+        (Transfer(id=1, pending_id=1000, timeout=5, flags=int(F.post_pending_transfer)),
+         TR.timeout_reserved_for_pending_transfer),
+        (Transfer(id=1, pending_id=4242, flags=int(F.post_pending_transfer)),
+         TR.pending_transfer_not_found),
+        (Transfer(id=1, pending_id=1000, debit_account_id=3, flags=int(F.post_pending_transfer)),
+         TR.pending_transfer_has_different_debit_account_id),
+        (Transfer(id=1, pending_id=1000, credit_account_id=3, flags=int(F.post_pending_transfer)),
+         TR.pending_transfer_has_different_credit_account_id),
+        (Transfer(id=1, pending_id=1000, ledger=LEDGER + 1, flags=int(F.post_pending_transfer)),
+         TR.pending_transfer_has_different_ledger),
+        (Transfer(id=1, pending_id=1000, code=99, flags=int(F.post_pending_transfer)),
+         TR.pending_transfer_has_different_code),
+        (Transfer(id=1, pending_id=1000, amount=51, flags=int(F.post_pending_transfer)),
+         TR.exceeds_pending_transfer_amount),
+        (Transfer(id=1, pending_id=1000, amount=49, flags=int(F.void_pending_transfer)),
+         TR.pending_transfer_has_different_amount),
+        # partial post ok:
+        (Transfer(id=2000, pending_id=1000, amount=30, flags=int(F.post_pending_transfer)),
+         TR.ok),
+        # second post: already posted
+        (Transfer(id=2001, pending_id=1000, amount=10, flags=int(F.post_pending_transfer)),
+         TR.pending_transfer_already_posted),
+    ]
+    codes = run_transfers(sm, [c[0] for c in cases], timestamp=20_000)
+    assert codes == [int(c[1]) for c in cases]
+    a1, a2 = sm.accounts[1], sm.accounts[2]
+    assert (a1.debits_pending, a1.debits_posted) == (0, 30)
+    assert (a2.credits_pending, a2.credits_posted) == (0, 30)
+
+
+def test_two_phase_void_and_not_pending():
+    sm = make_machine()
+    batch = [
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=LEDGER, code=1, flags=int(F.pending)),
+        Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=LEDGER, code=1),
+    ]
+    assert run_transfers(sm, batch, timestamp=100) == [0, 0]
+    void = Transfer(id=3, pending_id=1, flags=int(F.void_pending_transfer))
+    not_pending = Transfer(id=4, pending_id=2, flags=int(F.void_pending_transfer))
+    voided_again = Transfer(id=5, pending_id=1, flags=int(F.post_pending_transfer))
+    codes = run_transfers(sm, [void, not_pending, voided_again], timestamp=200)
+    assert codes == [0, TR.pending_transfer_not_pending, TR.pending_transfer_already_voided]
+    a1 = sm.accounts[1]
+    assert (a1.debits_pending, a1.debits_posted) == (0, 5)
+
+
+def test_two_phase_expired():
+    sm = make_machine()
+    pend = Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=5,
+                    ledger=LEDGER, code=1, flags=int(F.pending), timeout=1)
+    assert run_transfers(sm, [pend], timestamp=1000) == [0]
+    p_ts = sm.transfers[1].timestamp
+    post = Transfer(id=2, pending_id=1, flags=int(F.post_pending_transfer))
+    codes = run_transfers(sm, [post], timestamp=p_ts + NS_PER_S)
+    assert codes == [TR.pending_transfer_expired]
+    codes = run_transfers(
+        sm, [Transfer(id=3, pending_id=1, flags=int(F.post_pending_transfer))],
+        timestamp=p_ts + NS_PER_S - 1,
+    )
+    assert codes == [0]
+
+
+def test_post_exists_codes():
+    sm = make_machine()
+    run_transfers(sm, [Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                                amount=50, ledger=LEDGER, code=1, flags=int(F.pending))],
+                  timestamp=100)
+    post = Transfer(id=10, pending_id=1, amount=20, user_data_64=5,
+                    flags=int(F.post_pending_transfer))
+    import dataclasses as dc
+
+    batch = [
+        post,
+        # void with amount 20 < p.amount 50 fails the pre-exists amount check
+        # (reference: :950-952 runs before the exists lookup at :954).
+        dc.replace(post, flags=int(F.void_pending_transfer)),
+        dc.replace(post, amount=19),
+        dc.replace(post, amount=0),  # t.amount==0: e.amount(20) != p.amount(50)
+        dc.replace(post),
+        dc.replace(post, user_data_64=0),  # e.ud64=5 != p.ud64=0
+        dc.replace(post, user_data_64=7),
+    ]
+    codes = run_transfers(sm, batch, timestamp=200)
+    assert codes == [
+        0,
+        TR.pending_transfer_has_different_amount,
+        TR.exists_with_different_amount,
+        TR.exists_with_different_amount,
+        TR.exists,
+        TR.exists_with_different_user_data_64,
+        TR.exists_with_different_user_data_64,
+    ]
+
+
+# --- balancing transfers (reference: src/state_machine.zig:826-846) ---
+
+
+def test_balancing_debit():
+    sm = make_machine()
+    # Give account 1 credits_posted=100 by a transfer 2->1.
+    run_transfers(sm, [Transfer(id=1, debit_account_id=2, credit_account_id=1,
+                                amount=100, ledger=LEDGER, code=1)], timestamp=100)
+    # balancing_debit with amount=0 -> clamps to credits_posted - debits = 100.
+    t = Transfer(id=2, debit_account_id=1, credit_account_id=3, amount=0,
+                 ledger=LEDGER, code=1, flags=int(F.balancing_debit))
+    assert run_transfers(sm, [t], timestamp=200) == [0]
+    assert sm.transfers[2].amount == 100
+    assert sm.accounts[1].debits_posted == 100
+    # now balance exhausted -> exceeds_credits
+    t2 = Transfer(id=3, debit_account_id=1, credit_account_id=3, amount=10,
+                  ledger=LEDGER, code=1, flags=int(F.balancing_debit))
+    assert run_transfers(sm, [t2], timestamp=300) == [TR.exceeds_credits]
+
+
+def test_balancing_credit_clamp():
+    sm = make_machine()
+    run_transfers(sm, [Transfer(id=1, debit_account_id=3, credit_account_id=2,
+                                amount=40, ledger=LEDGER, code=1)], timestamp=100)
+    # account 3 has debits_posted=40; balancing_credit clamps credit into 3 at 40.
+    t = Transfer(id=2, debit_account_id=1, credit_account_id=3, amount=100,
+                 ledger=LEDGER, code=1, flags=int(F.balancing_credit))
+    assert run_transfers(sm, [t], timestamp=200) == [0]
+    assert sm.transfers[2].amount == 40
+
+
+# --- balance limit flags ---
+
+
+def test_debits_must_not_exceed_credits():
+    sm = make_machine(flags=(int(AccountFlags.debits_must_not_exceed_credits), 0, 0, 0))
+    run_transfers(sm, [Transfer(id=1, debit_account_id=2, credit_account_id=1,
+                                amount=30, ledger=LEDGER, code=1)], timestamp=100)
+    ok = Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=30,
+                  ledger=LEDGER, code=1)
+    over = Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=1,
+                    ledger=LEDGER, code=1)
+    assert run_transfers(sm, [ok, over], timestamp=200) == [0, TR.exceeds_credits]
+
+
+def test_credits_must_not_exceed_debits():
+    sm = make_machine(flags=(0, int(AccountFlags.credits_must_not_exceed_debits), 0, 0))
+    over = Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1,
+                    ledger=LEDGER, code=1)
+    assert run_transfers(sm, [over], timestamp=200) == [TR.exceeds_debits]
+
+
+# --- overflow ---
+
+
+def test_overflow_codes():
+    sm = make_machine()
+    big = Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                   amount=U128_MAX - 5, ledger=LEDGER, code=1)
+    assert run_transfers(sm, [big], timestamp=100) == [0]
+    t = Transfer(id=2, debit_account_id=1, credit_account_id=3, amount=10,
+                 ledger=LEDGER, code=1)
+    assert run_transfers(sm, [t], timestamp=200) == [TR.overflows_debits_posted]
+    t3 = Transfer(id=3, debit_account_id=3, credit_account_id=2, amount=10,
+                  ledger=LEDGER, code=1)
+    assert run_transfers(sm, [t3], timestamp=300) == [TR.overflows_credits_posted]
+    # pending overflow of debits (debits_pending + debits_posted)
+    p = Transfer(id=4, debit_account_id=1, credit_account_id=3, amount=5,
+                 ledger=LEDGER, code=1, flags=int(F.pending))
+    assert run_transfers(sm, [p], timestamp=400) == [0]
+    p2 = Transfer(id=5, debit_account_id=1, credit_account_id=3, amount=1,
+                  ledger=LEDGER, code=1, flags=int(F.pending))
+    assert run_transfers(sm, [p2], timestamp=500) == [TR.overflows_debits]
+
+
+def test_overflows_timeout():
+    sm = make_machine()
+    t = Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1,
+                 ledger=LEDGER, code=1, flags=int(F.pending), timeout=(1 << 32) - 1)
+    ts = U64_MAX - 1000
+    assert run_transfers(sm, [t], timestamp=ts) == [TR.overflows_timeout]
+
+
+# --- linked chains (reference: src/state_machine.zig:612-698) ---
+
+
+def test_linked_chain_all_succeed():
+    sm = make_machine()
+    batch = [
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1, flags=int(F.linked)),
+        Transfer(id=2, debit_account_id=2, credit_account_id=3, amount=10,
+                 ledger=LEDGER, code=1),
+    ]
+    assert run_transfers(sm, batch) == [0, 0]
+    assert sm.accounts[2].debits_posted == 10
+
+
+def test_linked_chain_rollback():
+    sm = make_machine()
+    batch = [
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1, flags=int(F.linked)),
+        Transfer(id=2, debit_account_id=1, credit_account_id=1, amount=10,
+                 ledger=LEDGER, code=1),  # fails: accounts_must_be_different
+        Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=7,
+                 ledger=LEDGER, code=1),  # independent, succeeds
+    ]
+    codes = run_transfers(sm, batch)
+    assert codes == [TR.linked_event_failed, TR.accounts_must_be_different, 0]
+    assert 1 not in sm.transfers  # rolled back
+    assert sm.accounts[1].debits_posted == 7
+
+
+def test_linked_chain_failure_mid_chain_skips_rest():
+    sm = make_machine()
+    batch = [
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1, flags=int(F.linked)),
+        Transfer(id=0, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1, flags=int(F.linked)),  # id==0 fails
+        Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1),  # chain tail: linked_event_failed
+        Transfer(id=4, debit_account_id=1, credit_account_id=2, amount=4,
+                 ledger=LEDGER, code=1),
+    ]
+    codes = run_transfers(sm, batch)
+    assert codes == [
+        TR.linked_event_failed,
+        TR.id_must_not_be_zero,
+        TR.linked_event_failed,
+        0,
+    ]
+    assert sm.accounts[1].debits_posted == 4
+
+
+def test_linked_event_chain_open():
+    sm = make_machine()
+    batch = [
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1, flags=int(F.linked)),
+        Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1, flags=int(F.linked)),
+    ]
+    codes = run_transfers(sm, batch)
+    assert codes == [TR.linked_event_failed, TR.linked_event_chain_open]
+    assert sm.accounts[1].debits_posted == 0
+
+
+def test_single_linked_event_chain_open():
+    sm = make_machine()
+    batch = [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                      ledger=LEDGER, code=1, flags=int(F.linked))]
+    assert run_transfers(sm, batch) == [TR.linked_event_chain_open]
+
+
+def test_two_chains_and_visibility():
+    sm = make_machine()
+    # Chain 1 rolls back; chain 2 must not see chain 1's insert (id reuse ok).
+    batch = [
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1, flags=int(F.linked)),
+        Transfer(id=2, debit_account_id=1, credit_account_id=1, amount=10,
+                 ledger=LEDGER, code=1),  # break chain 1
+        Transfer(id=1, debit_account_id=1, credit_account_id=3, amount=6,
+                 ledger=LEDGER, code=1, flags=int(F.linked)),  # id 1 again: no exists
+        Transfer(id=3, debit_account_id=3, credit_account_id=1, amount=6,
+                 ledger=LEDGER, code=1),
+    ]
+    codes = run_transfers(sm, batch)
+    assert codes == [TR.linked_event_failed, TR.accounts_must_be_different, 0, 0]
+    assert sm.transfers[1].credit_account_id == 3
+
+
+def test_chain_sparse_result_order():
+    sm = make_machine()
+    batch = [
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1, flags=int(F.linked)),
+        Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1, flags=int(F.linked)),
+        Transfer(id=3, debit_account_id=1, credit_account_id=1, amount=1,
+                 ledger=LEDGER, code=1),
+    ]
+    sparse = sm.execute(Operation.create_transfers, 10_000, batch)
+    assert sparse == [
+        (0, int(TR.linked_event_failed)),
+        (1, int(TR.linked_event_failed)),
+        (2, int(TR.accounts_must_be_different)),
+    ]
+
+
+def test_dup_id_in_batch_first_fails_second_succeeds():
+    sm = make_machine()
+    batch = [
+        Transfer(id=5, debit_account_id=1, credit_account_id=999, amount=10,
+                 ledger=LEDGER, code=1),  # credit_account_not_found
+        Transfer(id=5, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1),  # id free again -> ok
+        Transfer(id=5, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=LEDGER, code=1),  # exists
+    ]
+    codes = run_transfers(sm, batch)
+    assert codes == [TR.credit_account_not_found, 0, TR.exists]
+
+
+# --- in-batch pending chains ---
+
+
+def test_pending_created_and_posted_same_batch():
+    sm = make_machine()
+    batch = [
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=50,
+                 ledger=LEDGER, code=1, flags=int(F.pending)),
+        Transfer(id=2, pending_id=1, amount=0, flags=int(F.post_pending_transfer)),
+    ]
+    assert run_transfers(sm, batch) == [0, 0]
+    a1 = sm.accounts[1]
+    assert (a1.debits_pending, a1.debits_posted) == (0, 50)
+
+
+def test_lookup_accounts_and_transfers():
+    sm = make_machine()
+    run_transfers(sm, [Transfer(id=8, debit_account_id=1, credit_account_id=2,
+                                amount=3, ledger=LEDGER, code=1)])
+    found = sm.lookup_accounts([2, 424242, 1])
+    assert [a.id for a in found] == [2, 1]
+    assert found[1].debits_posted == 3
+    ts = sm.lookup_transfers([8, 9])
+    assert [t.id for t in ts] == [8]
+    assert ts[0].amount == 3
+
+
+def test_workload_generator_runs():
+    from tigerbeetle_tpu.testing.workload import WorkloadGenerator
+    from tigerbeetle_tpu.types import Operation as Op
+
+    gen = WorkloadGenerator(seed=7)
+    sm = OracleStateMachine()
+    ts = 0
+    for _ in range(6):
+        op, accounts = gen.gen_accounts_batch(50)
+        ts += len(accounts)
+        sm.execute_dense(op, ts, accounts)
+        op, transfers = gen.gen_transfers_batch(200)
+        ts += len(transfers)
+        codes = sm.execute_dense(op, ts, transfers)
+        assert len(codes) == 200
+    # the workload must exercise both success and a diversity of errors
+    assert sm.transfers and sm.accounts
+    assert any(c == 0 for c in codes)

@@ -1,0 +1,140 @@
+"""Struct layout golden tests vs. the reference's extern struct byte layouts
+(reference: src/tigerbeetle.zig:7-104)."""
+
+import numpy as np
+
+from tigerbeetle_tpu.constants import U128_MAX
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.types import (
+    ACCOUNT_DTYPE,
+    TRANSFER_DTYPE,
+    Account,
+    CreateAccountResult,
+    CreateTransferResult,
+    Transfer,
+)
+
+
+def test_sizes():
+    assert ACCOUNT_DTYPE.itemsize == 128
+    assert TRANSFER_DTYPE.itemsize == 128
+
+
+def test_account_field_offsets():
+    # reference src/tigerbeetle.zig:7-29 field order, no padding.
+    offsets = {
+        name: ACCOUNT_DTYPE.fields[name][1] for name in ACCOUNT_DTYPE.names
+    }
+    assert offsets["id_lo"] == 0
+    assert offsets["id_hi"] == 8
+    assert offsets["debits_pending_lo"] == 16
+    assert offsets["debits_posted_lo"] == 32
+    assert offsets["credits_pending_lo"] == 48
+    assert offsets["credits_posted_lo"] == 64
+    assert offsets["user_data_128_lo"] == 80
+    assert offsets["user_data_64"] == 96
+    assert offsets["user_data_32"] == 104
+    assert offsets["reserved"] == 108
+    assert offsets["ledger"] == 112
+    assert offsets["code"] == 116
+    assert offsets["flags"] == 118
+    assert offsets["timestamp"] == 120
+
+
+def test_transfer_field_offsets():
+    # reference src/tigerbeetle.zig:64-89.
+    offsets = {
+        name: TRANSFER_DTYPE.fields[name][1] for name in TRANSFER_DTYPE.names
+    }
+    assert offsets["id_lo"] == 0
+    assert offsets["debit_account_id_lo"] == 16
+    assert offsets["credit_account_id_lo"] == 32
+    assert offsets["amount_lo"] == 48
+    assert offsets["pending_id_lo"] == 64
+    assert offsets["user_data_128_lo"] == 80
+    assert offsets["user_data_64"] == 96
+    assert offsets["user_data_32"] == 104
+    assert offsets["timeout"] == 108
+    assert offsets["ledger"] == 112
+    assert offsets["code"] == 116
+    assert offsets["flags"] == 118
+    assert offsets["timestamp"] == 120
+
+
+def test_u128_split_join_roundtrip():
+    for x in (0, 1, (1 << 64) - 1, 1 << 64, U128_MAX, 0xDEADBEEF << 77):
+        lo, hi = types.split_u128(x)
+        assert types.join_u128(lo, hi) == x
+
+
+def test_account_np_roundtrip():
+    a = Account(
+        id=(123 << 64) | 456,
+        debits_pending=U128_MAX - 1,
+        credits_posted=7,
+        user_data_128=0xABCDEF << 60,
+        user_data_64=99,
+        user_data_32=3,
+        ledger=700,
+        code=10,
+        flags=3,
+        timestamp=1234567,
+    )
+    row = a.to_np()[0]
+    assert Account.from_np(row) == a
+
+
+def test_transfer_np_roundtrip():
+    t = Transfer(
+        id=U128_MAX - 3,
+        debit_account_id=1,
+        credit_account_id=2,
+        amount=(1 << 127) + 5,
+        pending_id=42,
+        user_data_64=8,
+        timeout=30,
+        ledger=1,
+        code=5,
+        flags=2,
+        timestamp=999,
+    )
+    row = t.to_np()[0]
+    assert Transfer.from_np(row) == t
+
+
+def test_transfer_bytes_golden():
+    # Byte-level golden: id=1, amount=2^64 (hi limb = 1), flags=pending.
+    t = Transfer(id=1, debit_account_id=2, credit_account_id=3, amount=1 << 64,
+                 ledger=1, code=1, flags=2)
+    raw = t.to_np().tobytes()
+    assert len(raw) == 128
+    assert raw[0:16] == (1).to_bytes(16, "little")
+    assert raw[16:32] == (2).to_bytes(16, "little")
+    assert raw[32:48] == (3).to_bytes(16, "little")
+    assert raw[48:64] == (1 << 64).to_bytes(16, "little")
+    assert raw[118:120] == (2).to_bytes(2, "little")  # flags
+    assert raw[120:128] == (0).to_bytes(8, "little")
+
+
+def test_result_enum_values():
+    # Wire-protocol values (reference: src/tigerbeetle.zig:109-229).
+    assert CreateAccountResult.exists == 21
+    assert len(CreateAccountResult) == 22
+    assert CreateTransferResult.exceeds_debits == 55
+    assert len(CreateTransferResult) == 56
+    assert CreateTransferResult.overflows_timeout == 53
+    assert list(CreateTransferResult) == sorted(CreateTransferResult)
+
+
+def test_flags_values():
+    from tigerbeetle_tpu.types import AccountFlags, TransferFlags
+
+    assert AccountFlags.linked == 1
+    assert AccountFlags.debits_must_not_exceed_credits == 2
+    assert AccountFlags.credits_must_not_exceed_debits == 4
+    assert TransferFlags.pending == 2
+    assert TransferFlags.post_pending_transfer == 4
+    assert TransferFlags.void_pending_transfer == 8
+    assert TransferFlags.balancing_debit == 16
+    assert TransferFlags.balancing_credit == 32
+    assert np.uint16(TransferFlags.padding_mask()) == 0xFFC0

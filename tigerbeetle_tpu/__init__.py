@@ -1,0 +1,33 @@
+"""tigerbeetle_tpu — a TPU-native double-entry accounting database framework.
+
+A ground-up redesign of the capabilities of TigerBeetle (reference:
+/root/reference, Zig) for TPU hardware:
+
+- The batched ledger commit path (create_accounts / create_transfers /
+  lookup_* — reference src/state_machine.zig) executes as JAX kernels over
+  struct-of-arrays batches, with the account + transfer stores resident in
+  HBM as open-addressing hash tables.
+- u128 balances/ids are exact two-limb (2 x u64) arithmetic on device.
+- Batches with no intra-batch conflicts take a fully vectorized path; batches
+  with serial dependencies (duplicate ids, linked chains, balancing
+  transfers, balance-limit accounts, in-batch pending references) fall back
+  to an exact sequential lax.scan kernel. Result codes are bit-exact vs. the
+  reference state machine in both paths.
+- Multi-chip scaling shards the HBM tables over a `jax.sharding.Mesh`
+  (see tigerbeetle_tpu.parallel).
+
+The surrounding systems layers (VSR consensus, WAL/superblock durability,
+LSM indexes, message bus, deterministic simulator) live in vsr/, lsm/, io/,
+testing/ as host-side runtime around the device state machine.
+
+NOTE: importing this package enables jax_enable_x64 (u64 limbs are the
+native word of the whole framework).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from tigerbeetle_tpu import constants, types  # noqa: E402,F401
+
+__version__ = "0.1.0"

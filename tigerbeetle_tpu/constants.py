@@ -1,0 +1,87 @@
+"""Cluster/process configuration constants.
+
+Mirrors the reference's two-tier comptime config (reference: src/config.zig:130-144
+ConfigCluster, :73-121 ConfigProcess; derived values src/constants.zig). Cluster
+values are consensus-affecting and must match across replicas; process values are
+per-replica tuning knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+U64_MAX = (1 << 64) - 1
+U128_MAX = (1 << 128) - 1
+
+NS_PER_S = 1_000_000_000
+
+# --- wire sizes (reference: src/constants.zig:167-168, src/config.zig:137) ---
+HEADER_SIZE = 128
+MESSAGE_SIZE_MAX = 1 << 20  # 1 MiB
+MESSAGE_BODY_SIZE_MAX = MESSAGE_SIZE_MAX - HEADER_SIZE
+
+ACCOUNT_SIZE = 128
+TRANSFER_SIZE = 128
+
+# The max batch size: (1 MiB - 128 B) / 128 B = 8191 in this snapshot
+# (reference: src/state_machine.zig:46-65 operation_batch_max,
+# src/benchmark.zig:52-59 @divExact). Note BASELINE.md's benchmark protocol
+# quotes batch=8190; BENCH_BATCH follows the protocol, BATCH_MAX the formula.
+BATCH_MAX = MESSAGE_BODY_SIZE_MAX // TRANSFER_SIZE
+assert BATCH_MAX == 8191
+BENCH_BATCH = 8190
+
+# Device kernels pad every batch to a static shape (XLA: static shapes only).
+BATCH_PAD = 8192
+assert BATCH_PAD >= BATCH_MAX
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigCluster:
+    """Consensus-affecting constants (reference: src/config.zig:130-144)."""
+
+    cluster_id: int = 0
+    replica_count: int = 1
+    message_size_max: int = MESSAGE_SIZE_MAX
+    journal_slot_count: int = 1024
+    clients_max: int = 32
+    pipeline_prepare_queue_max: int = 8
+    view_change_headers_suffix_max: int = 8 + 1
+    quorum_replication_max: int = 3
+    block_size: int = 1 << 17  # 128 KiB grid blocks
+    lsm_levels: int = 7
+    lsm_growth_factor: int = 8
+    lsm_batch_multiple: int = 64  # ops per "bar" (checkpoint interval unit)
+
+    @property
+    def batch_max(self) -> int:
+        return (self.message_size_max - HEADER_SIZE) // TRANSFER_SIZE
+
+    @property
+    def checkpoint_interval(self) -> int:
+        # reference: src/vsr.zig:2003-2035 Checkpoint arithmetic.
+        return self.journal_slot_count - self.lsm_batch_multiple
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigProcess:
+    """Per-replica tuning (reference: src/config.zig:73-121)."""
+
+    tick_ms: int = 10
+    # Device table capacities (slots; power of two). The analog of the
+    # reference's cache_entries_accounts/transfers + grid cache: here the
+    # full working store is HBM-resident.
+    account_slots_log2: int = 20  # 1M account slots
+    transfer_slots_log2: int = 24  # 16.7M transfer slots
+    # Sequential-repair scan capacity for the hybrid kernel (Tier B).
+    repair_slots: int = 1024
+    journal_iops_read_max: int = 8
+    journal_iops_write_max: int = 8
+
+
+DEFAULT_CLUSTER = ConfigCluster()
+DEFAULT_PROCESS = ConfigProcess()
+
+# Small configs for tests/simulator (reference: src/config.zig:232-272 test_min).
+TEST_CLUSTER = ConfigCluster(journal_slot_count=64, lsm_batch_multiple=4)
+TEST_PROCESS = ConfigProcess(account_slots_log2=10, transfer_slots_log2=12)
