@@ -1,0 +1,119 @@
+"""Benchmark driver: the reference's scripts/benchmark.sh protocol on TPU.
+
+Reference protocol (reference: src/benchmark.zig:23-73, scripts/benchmark.sh):
+10_000 accounts, transfers submitted in batches of 8190, measure transfers/s.
+Here the state machine is the device ledger (tigerbeetle_tpu/models/ledger.py)
+executing whole batches as single jitted commit steps; the host driver plays
+the role of the benchmark client (id_order=reversed like the reference default,
+two uniform-random distinct accounts per transfer).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "transfers/s", "vs_baseline": N}
+vs_baseline is value / 1e6 — the reference's "~1M financial transactions/s"
+headline on its own benchmark (reference: README.md:134-135, docs/HISTORY.md:31
+800k/s AlphaBeetle; BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+BASELINE_TPS = 1_000_000.0  # reference headline (BASELINE.md)
+N_ACCOUNTS = 10_000
+BATCH = 8190  # (1 MiB - 128 B) / 128 B, reference: src/constants.zig:167-168
+N_BATCHES_WARMUP = 3
+N_BATCHES = 40  # 40 * 8190 = 327_600 transfers measured
+
+
+def build_account_batch(start_id: int, count: int, ledger: int = 1) -> np.ndarray:
+    from tigerbeetle_tpu.types import ACCOUNT_DTYPE
+
+    arr = np.zeros(count, dtype=ACCOUNT_DTYPE)
+    arr["id_lo"] = np.arange(start_id, start_id + count, dtype=np.uint64)
+    arr["ledger"] = ledger
+    arr["code"] = 1
+    return arr
+
+
+def build_transfer_batch(rng, start_id: int, count: int, ledger: int = 1) -> np.ndarray:
+    from tigerbeetle_tpu.types import TRANSFER_DTYPE
+
+    arr = np.zeros(count, dtype=TRANSFER_DTYPE)
+    # id_order=reversed (reference: src/benchmark.zig:66-73 default).
+    arr["id_lo"] = np.arange(start_id + count - 1, start_id - 1, -1, dtype=np.uint64)
+    dr = rng.integers(1, N_ACCOUNTS + 1, size=count, dtype=np.uint64)
+    off = rng.integers(1, N_ACCOUNTS, size=count, dtype=np.uint64)
+    cr = (dr - 1 + off) % N_ACCOUNTS + 1  # distinct from dr
+    arr["debit_account_id_lo"] = dr
+    arr["credit_account_id_lo"] = cr
+    arr["amount_lo"] = 1
+    arr["ledger"] = ledger
+    arr["code"] = 1
+    return arr
+
+
+def main() -> None:
+    import jax
+
+    from tigerbeetle_tpu.constants import BATCH_PAD, ConfigProcess
+    from tigerbeetle_tpu.models.ledger import DeviceLedger
+
+    process = ConfigProcess(account_slots_log2=16, transfer_slots_log2=24)
+    ledger = DeviceLedger(process=process, mode="auto")
+    ledger.pad_to = BATCH_PAD
+
+    from tigerbeetle_tpu.types import Operation
+
+    ts = 1 << 40
+    rng = np.random.default_rng(42)
+
+    # Load accounts (8190-per-batch like the reference client).
+    next_id = 1
+    while next_id <= N_ACCOUNTS:
+        n = min(BATCH, N_ACCOUNTS - next_id + 1)
+        batch = build_account_batch(next_id, n)
+        ts += n
+        res = ledger.execute(Operation.create_accounts, ts, batch)
+        assert res == [], res[:5]
+        next_id += n
+
+    # Warmup (compile + cache).
+    xfer_id = 1
+    for _ in range(N_BATCHES_WARMUP):
+        batch = build_transfer_batch(rng, xfer_id, BATCH)
+        ts += BATCH
+        res = ledger.execute(Operation.create_transfers, ts, batch)
+        assert res == [], res[:5]
+        xfer_id += BATCH
+
+    # Timed run. execute() blocks on the dense result transfer each batch,
+    # which is the same sync point the reference's client ack provides.
+    t0 = time.perf_counter()
+    for _ in range(N_BATCHES):
+        batch = build_transfer_batch(rng, xfer_id, BATCH)
+        ts += BATCH
+        res = ledger.execute(Operation.create_transfers, ts, batch)
+        assert res == [], res[:5]
+        xfer_id += BATCH
+    jax.block_until_ready(ledger.state["commit_ts"])
+    dt = time.perf_counter() - t0
+
+    tps = N_BATCHES * BATCH / dt
+    print(
+        json.dumps(
+            {
+                "metric": "create_transfers throughput, batch=8190, 10k accounts",
+                "value": round(tps, 1),
+                "unit": "transfers/s",
+                "vs_baseline": round(tps / BASELINE_TPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

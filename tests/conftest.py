@@ -1,15 +1,20 @@
 """Test env: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any jax import (hence top-level in conftest). Real-TPU
+The session image pins jax_platforms to the tunneled real-TPU platform at the
+config level (env JAX_PLATFORMS is ignored), so this must be overridden via
+jax.config after import — BEFORE any backend initialization. Real-TPU
 execution is exercised by bench.py / the driver, not the unit suite
 (SURVEY.md §4: deterministic in-process testing is the primary harness).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

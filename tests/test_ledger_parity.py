@@ -1,0 +1,204 @@
+"""Bit-exact parity: device ledger kernels vs. the oracle state machine.
+
+The analog of the reference's state-machine unit tier + auditor
+(reference: src/state_machine.zig:1181-1299 TestContext,
+src/state_machine/auditor.zig): every batch from the randomized workload runs
+through both implementations; dense result codes must match exactly, and the
+full extracted store state must match periodically.
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.constants import TEST_PROCESS
+from tigerbeetle_tpu.models.ledger import DeviceLedger
+from tigerbeetle_tpu.models.oracle import OracleStateMachine
+from tigerbeetle_tpu.testing.workload import WorkloadGenerator
+from tigerbeetle_tpu.types import Operation, Transfer
+
+
+def run_parity(seed, n_batches, batch_size, mode, state_every=4, **wl_kwargs):
+    oracle = OracleStateMachine()
+    dev = DeviceLedger(process=TEST_PROCESS, mode=mode)
+    gen = WorkloadGenerator(seed, **wl_kwargs)
+    ts = 1_000_000_000
+    for b in range(n_batches):
+        if b % 4 == 0:
+            op, events = gen.gen_accounts_batch(batch_size)
+        else:
+            op, events = gen.gen_transfers_batch(batch_size)
+        ts += len(events)
+        dense_o = oracle.execute_dense(op, ts, events)
+        dense_d = dev.execute_dense(op, ts, events)
+        if dense_d != dense_o:
+            diffs = [
+                (i, o, d) for i, (o, d) in enumerate(zip(dense_o, dense_d)) if o != d
+            ]
+            raise AssertionError(f"batch {b} ({op.name}): (idx, oracle, dev) {diffs[:10]}")
+        if b % state_every == state_every - 1:
+            accounts, transfers, posted = dev.extract()
+            assert accounts == oracle.accounts, f"batch {b}: account state diverged"
+            assert transfers == oracle.transfers, f"batch {b}: transfer state diverged"
+            assert posted == oracle.posted, f"batch {b}: posted state diverged"
+            assert dev.commit_timestamp == oracle.commit_timestamp
+    return oracle, dev
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_serial_parity(seed):
+    run_parity(seed, n_batches=10, batch_size=40, mode="serial")
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_auto_parity(seed):
+    run_parity(seed, n_batches=10, batch_size=40, mode="auto")
+
+
+def test_auto_parity_clean_workload():
+    """A hazard-free workload (no chains/two-phase/balancing/limits) exercises
+    the vectorized tier under auto dispatch."""
+    run_parity(
+        5,
+        n_batches=8,
+        batch_size=40,
+        mode="auto",
+        chain_rate=0.0,
+        two_phase_rate=0.0,
+        balancing_rate=0.0,
+        limit_account_rate=0.0,
+        conflict_rate=0.0,
+    )
+
+
+def test_fast_tier_forced_clean_workload():
+    """mode="fast" bypasses the hazard cond entirely — validates the
+    vectorized tier in isolation (duplicate account ids across dr/cr lanes
+    still occur, exercising the digit scatter-add accumulation)."""
+    run_parity(
+        6,
+        n_batches=8,
+        batch_size=40,
+        mode="fast",
+        chain_rate=0.0,
+        two_phase_rate=0.0,
+        balancing_rate=0.0,
+        limit_account_rate=0.0,
+        conflict_rate=0.0,
+        invalid_rate=0.3,
+    )
+
+
+def test_lookup_parity():
+    oracle, dev = run_parity(7, n_batches=6, batch_size=32, mode="auto", state_every=100)
+    gen = WorkloadGenerator(99)
+    gen.account_ids = list(oracle.accounts.keys())[:50]
+    gen.transfer_ids = list(oracle.transfers.keys())[:50]
+    _, ids_a = gen.gen_lookup_batch(40, "accounts")
+    _, ids_t = gen.gen_lookup_batch(40, "transfers")
+    assert dev.lookup_accounts(ids_a) == oracle.lookup_accounts(ids_a)
+    assert dev.lookup_transfers(ids_t) == oracle.lookup_transfers(ids_t)
+
+
+def test_serial_linked_chain_rollback_exact():
+    """Directed: a linked chain that fails mid-way must roll back inserts and
+    balance changes (reference: src/state_machine.zig:612-698 scopes)."""
+    from tigerbeetle_tpu.types import Account
+
+    oracle = OracleStateMachine()
+    dev = DeviceLedger(process=TEST_PROCESS, mode="serial")
+    ts = 10_000
+    accounts = [Account(id=i, ledger=1, code=1) for i in (1, 2, 3)]
+    ts += 3
+    assert oracle.execute_dense(Operation.create_accounts, ts, accounts) == \
+        dev.execute_dense(Operation.create_accounts, ts, accounts)
+
+    # chain: ok, ok, FAIL(amount=0) -> all three fail; trailing standalone ok.
+    transfers = [
+        Transfer(id=10, debit_account_id=1, credit_account_id=2, amount=5, ledger=1, code=1, flags=1),
+        Transfer(id=11, debit_account_id=2, credit_account_id=3, amount=7, ledger=1, code=1, flags=1),
+        Transfer(id=12, debit_account_id=1, credit_account_id=3, amount=0, ledger=1, code=1),
+        Transfer(id=13, debit_account_id=1, credit_account_id=2, amount=9, ledger=1, code=1),
+    ]
+    ts += 4
+    dense_o = oracle.execute_dense(Operation.create_transfers, ts, transfers)
+    dense_d = dev.execute_dense(Operation.create_transfers, ts, transfers)
+    assert dense_o == [1, 1, 18, 0]
+    assert dense_d == dense_o
+    accounts_d, transfers_d, posted_d = dev.extract()
+    assert accounts_d == oracle.accounts
+    assert transfers_d == oracle.transfers
+    # Rolled-back ids must be absent; id=13 present.
+    assert 10 not in transfers_d and 11 not in transfers_d and 12 not in transfers_d
+    assert 13 in transfers_d
+
+
+def test_commit_ts_survives_full_chain_rollback():
+    """commit_timestamp advances on at-the-time-ok events and is NOT restored
+    by chain rollback (the reference's scopes cover grooves only)."""
+    from tigerbeetle_tpu.types import Account
+
+    oracle = OracleStateMachine()
+    dev = DeviceLedger(process=TEST_PROCESS, mode="serial")
+    ts = 10_000
+    accounts = [Account(id=i, ledger=1, code=1) for i in (1, 2)]
+    ts += 2
+    oracle.execute_dense(Operation.create_accounts, ts, accounts)
+    dev.execute_dense(Operation.create_accounts, ts, accounts)
+    # The only ok event is rolled back by its chain: commit_ts still moves.
+    transfers = [
+        Transfer(id=30, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=1, flags=1),
+        Transfer(id=31, debit_account_id=1, credit_account_id=2, amount=0,
+                 ledger=1, code=1),
+    ]
+    ts += 2
+    assert oracle.execute_dense(Operation.create_transfers, ts, transfers) == \
+        dev.execute_dense(Operation.create_transfers, ts, transfers) == [1, 18]
+    assert dev.commit_timestamp == oracle.commit_timestamp
+
+
+def test_capacity_guard():
+    import pytest as _pytest
+
+    from tigerbeetle_tpu.constants import ConfigProcess
+    from tigerbeetle_tpu.types import Account
+
+    dev = DeviceLedger(
+        process=ConfigProcess(account_slots_log2=4, transfer_slots_log2=6), mode="auto"
+    )
+    accounts = [Account(id=i, ledger=1, code=1) for i in range(1, 16)]
+    with _pytest.raises(RuntimeError, match="load-factor"):
+        dev.execute_dense(Operation.create_accounts, 100, accounts)
+
+
+def test_serial_two_phase_post_void_in_batch():
+    """Directed: pending + post in the same batch (intra-batch reference)."""
+    from tigerbeetle_tpu.types import Account, TransferFlags
+
+    oracle = OracleStateMachine()
+    dev = DeviceLedger(process=TEST_PROCESS, mode="serial")
+    ts = 10_000
+    accounts = [Account(id=i, ledger=1, code=1) for i in (1, 2)]
+    ts += 2
+    oracle.execute_dense(Operation.create_accounts, ts, accounts)
+    dev.execute_dense(Operation.create_accounts, ts, accounts)
+
+    transfers = [
+        Transfer(id=20, debit_account_id=1, credit_account_id=2, amount=100,
+                 ledger=1, code=1, flags=int(TransferFlags.pending)),
+        Transfer(id=21, pending_id=20, amount=60, ledger=0, code=0,
+                 flags=int(TransferFlags.post_pending_transfer)),
+        Transfer(id=22, pending_id=20, ledger=0, code=0,
+                 flags=int(TransferFlags.void_pending_transfer)),  # already posted
+    ]
+    ts += 3
+    dense_o = oracle.execute_dense(Operation.create_transfers, ts, transfers)
+    dense_d = dev.execute_dense(Operation.create_transfers, ts, transfers)
+    assert dense_o == [0, 0, 33]  # pending_transfer_already_posted
+    assert dense_d == dense_o
+    accounts_d, transfers_d, posted_d = dev.extract()
+    assert accounts_d == oracle.accounts
+    assert transfers_d == oracle.transfers
+    assert posted_d == oracle.posted
+    a1 = accounts_d[1]
+    assert a1.debits_posted == 60 and a1.debits_pending == 0

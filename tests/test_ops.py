@@ -1,0 +1,153 @@
+"""Unit tests for the u128 limb arithmetic and the HBM hash table ops."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from tigerbeetle_tpu.ops import hashtable as ht
+from tigerbeetle_tpu.ops import u128
+
+U128_MAX = (1 << 128) - 1
+U64_MAX = (1 << 64) - 1
+
+
+def _split_np(xs):
+    lo = np.array([x & U64_MAX for x in xs], dtype=np.uint64)
+    hi = np.array([x >> 64 for x in xs], dtype=np.uint64)
+    return jnp.asarray(lo), jnp.asarray(hi)
+
+
+def _join_np(lo, hi):
+    return [(int(h) << 64) | int(l) for l, h in zip(np.asarray(lo), np.asarray(hi))]
+
+
+def test_u128_add_sub_cmp_random():
+    rng = random.Random(7)
+    edge = [0, 1, U64_MAX, U64_MAX + 1, U128_MAX - 1, U128_MAX]
+    xs = edge + [rng.randint(0, U128_MAX) for _ in range(200)]
+    ys = list(reversed(edge)) + [rng.randint(0, U128_MAX) for _ in range(200)]
+    a_lo, a_hi = _split_np(xs)
+    b_lo, b_hi = _split_np(ys)
+
+    lo, hi, c = u128.add(a_lo, a_hi, b_lo, b_hi)
+    assert _join_np(lo, hi) == [(a + b) & U128_MAX for a, b in zip(xs, ys)]
+    assert np.asarray(c).tolist() == [a + b > U128_MAX for a, b in zip(xs, ys)]
+
+    lo, hi, brw = u128.sub(a_lo, a_hi, b_lo, b_hi)
+    assert _join_np(lo, hi) == [(a - b) & U128_MAX for a, b in zip(xs, ys)]
+    assert np.asarray(brw).tolist() == [a < b for a, b in zip(xs, ys)]
+
+    lo, hi = u128.sat_sub(a_lo, a_hi, b_lo, b_hi)
+    assert _join_np(lo, hi) == [max(0, a - b) for a, b in zip(xs, ys)]
+
+    assert np.asarray(u128.lt(a_lo, a_hi, b_lo, b_hi)).tolist() == [
+        a < b for a, b in zip(xs, ys)
+    ]
+    assert np.asarray(u128.gt(a_lo, a_hi, b_lo, b_hi)).tolist() == [
+        a > b for a, b in zip(xs, ys)
+    ]
+    assert np.asarray(u128.eq(a_lo, a_hi, b_lo, b_hi)).tolist() == [
+        a == b for a, b in zip(xs, ys)
+    ]
+    lo, hi = u128.min_(a_lo, a_hi, b_lo, b_hi)
+    assert _join_np(lo, hi) == [min(a, b) for a, b in zip(xs, ys)]
+    assert np.asarray(u128.sum_overflows(a_lo, a_hi, b_lo, b_hi)).tolist() == [
+        a + b > U128_MAX for a, b in zip(xs, ys)
+    ]
+    assert np.asarray(u128.is_zero(a_lo, a_hi)).tolist() == [a == 0 for a in xs]
+    assert np.asarray(u128.is_max(a_lo, a_hi)).tolist() == [a == U128_MAX for a in xs]
+
+
+def test_u64_sum_overflows():
+    a = jnp.asarray(np.array([U64_MAX, U64_MAX - 1, 0], dtype=np.uint64))
+    b = jnp.asarray(np.array([1, 1, 0], dtype=np.uint64))
+    assert np.asarray(u128.sum_overflows_u64(a, b)).tolist() == [True, False, False]
+
+
+def _mk_table(log2):
+    rows = (1 << log2) + 1
+    return jnp.zeros(rows, dtype=jnp.uint64), jnp.zeros(rows, dtype=jnp.uint64)
+
+
+def test_hashtable_insert_then_lookup():
+    log2 = 8
+    k_lo, k_hi = _mk_table(log2)
+    claim = jnp.full((1 << log2) + 1, ht.CLAIM_FREE, dtype=jnp.uint32)
+    rng = random.Random(3)
+    keys = sorted({rng.randint(1, U128_MAX - 1) for _ in range(150)})
+    lo, hi = _split_np(keys)
+    active = jnp.ones(len(keys), dtype=bool)
+    slots, k_lo, k_hi, claim = ht.insert_slots(lo, hi, active, k_lo, k_hi, claim, log2)
+    slots = np.asarray(slots)
+    # All inserted at distinct, in-range slots; claim scratch fully reset.
+    assert len(set(slots.tolist())) == len(keys)
+    assert slots.max() < (1 << log2)
+    assert bool(jnp.all(claim == ht.CLAIM_FREE))
+    # Every key found at its claimed slot.
+    got_slots, found = ht.lookup(lo, hi, k_lo, k_hi, log2)
+    assert bool(jnp.all(found))
+    assert np.array_equal(np.asarray(got_slots), slots)
+    # Absent keys (same lo limb, different hi limb) not found.
+    absent_hi = hi ^ jnp.uint64(0xDEADBEEF)
+    _, found2 = ht.lookup(lo, absent_hi, k_lo, k_hi, log2)
+    assert not bool(jnp.any(found2))
+
+
+def test_hashtable_insert_inactive_lanes_untouched():
+    log2 = 6
+    k_lo, k_hi = _mk_table(log2)
+    claim = jnp.full((1 << log2) + 1, ht.CLAIM_FREE, dtype=jnp.uint32)
+    lo, hi = _split_np([10, 11, 12, 13])
+    active = jnp.asarray([True, False, True, False])
+    slots, k_lo, k_hi, claim = ht.insert_slots(lo, hi, active, k_lo, k_hi, claim, log2)
+    _, found = ht.lookup(lo, hi, k_lo, k_hi, log2)
+    assert np.asarray(found).tolist() == [True, False, True, False]
+    assert int(np.asarray(slots)[1]) == 1 << log2  # dump slot for inactive
+
+
+def test_hashtable_scalar_probe_and_tombstone():
+    log2 = 4
+    k_lo, k_hi = _mk_table(log2)
+    slot = ht.probe_free_scalar(jnp.uint64(42), jnp.uint64(0), k_lo, k_hi, log2)
+    k_lo = k_lo.at[slot].set(jnp.uint64(42))
+    s2, found = ht.lookup(jnp.uint64(42), jnp.uint64(0), k_lo, k_hi, log2)
+    assert bool(found) and int(s2) == int(slot)
+    # Tombstone the slot: lookup misses, probe_free reuses it.
+    k_lo = k_lo.at[slot].set(ht.TOMB)
+    k_hi = k_hi.at[slot].set(ht.TOMB)
+    _, found3 = ht.lookup(jnp.uint64(42), jnp.uint64(0), k_lo, k_hi, log2)
+    assert not bool(found3)
+    s4 = ht.probe_free_scalar(jnp.uint64(42), jnp.uint64(0), k_lo, k_hi, log2)
+    assert int(s4) == int(slot)
+
+
+def test_hashtable_lookup_skips_tombstone_in_chain():
+    # Two keys on one collision chain: tombstoning the first must not hide
+    # the second (tombstone != empty for probe termination).
+    log2 = 4
+    k_lo, k_hi = _mk_table(log2)
+    h0 = int(ht.hash_u128(jnp.uint64(1), jnp.uint64(0), log2))
+    k_lo = k_lo.at[h0].set(jnp.uint64(1))
+    nxt = (h0 + 1) & ((1 << log2) - 1)
+    k_lo = k_lo.at[nxt].set(jnp.uint64(777))
+    s, found = ht.lookup(jnp.uint64(777), jnp.uint64(0), k_lo, k_hi, log2)
+    # 777 may hash elsewhere; place it explicitly on 1's chain instead.
+    k_lo = k_lo.at[nxt].set(jnp.uint64(0))
+    h777 = int(ht.hash_u128(jnp.uint64(777), jnp.uint64(0), log2))
+    if h777 != h0:
+        # Force a chain: fill h777..h0 path is fiddly; instead just verify
+        # tombstone-skip on 777's own chain.
+        k_lo = k_lo.at[h777].set(ht.TOMB)
+        k_hi = k_hi.at[h777].set(ht.TOMB)
+        nxt777 = (h777 + 1) & ((1 << log2) - 1)
+        k_lo = k_lo.at[nxt777].set(jnp.uint64(777))
+        k_hi = k_hi.at[nxt777].set(jnp.uint64(0))
+        s, found = ht.lookup(jnp.uint64(777), jnp.uint64(0), k_lo, k_hi, log2)
+        assert bool(found) and int(s) == nxt777
+    else:
+        k_lo = k_lo.at[h0].set(ht.TOMB)
+        k_hi = k_hi.at[h0].set(ht.TOMB)
+        k_lo = k_lo.at[nxt].set(jnp.uint64(777))
+        s, found = ht.lookup(jnp.uint64(777), jnp.uint64(0), k_lo, k_hi, log2)
+        assert bool(found) and int(s) == nxt

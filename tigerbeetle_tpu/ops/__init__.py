@@ -1,0 +1,1 @@
+from tigerbeetle_tpu.ops import hashtable, u128  # noqa: F401

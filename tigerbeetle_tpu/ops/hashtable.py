@@ -1,0 +1,147 @@
+"""HBM-resident open-addressing hash tables keyed by u128 ids.
+
+This is the TPU-native replacement for the reference's Groove object store +
+CacheMap (reference: src/lsm/groove.zig:602-760, src/lsm/cache_map.zig): instead
+of an LSM-backed cache with async prefetch, the full working set lives in HBM
+as struct-of-arrays columns over `capacity + 1` slots. Slot `capacity` is a
+write dump for masked scatters (predicated lanes write there and the row is
+never read). Probing is linear with a batched while_loop: every lane gathers
+its candidate slot each iteration, so a batch of 8190 lookups costs
+O(max probe chain) gathers of the whole batch, not O(batch) serial probes.
+
+Key encoding:
+- empty slot:      key == (0, 0)        (valid ids are never 0)
+- tombstone slot:  key == (2^64-1, 2^64-1)  (valid ids are never u128 max;
+  both invariants are enforced by id_must_not_be_zero / id_must_not_be_int_max,
+  reference: src/tigerbeetle.zig:118-121, 160-163)
+Tombstones arise only from linked-chain rollback deletions; lookups skip them,
+inserts reuse them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+U64 = jnp.uint64
+U32 = jnp.uint32
+I32 = jnp.int32
+
+EMPTY = jnp.uint64(0)
+TOMB = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+CLAIM_FREE = jnp.uint32(0xFFFFFFFF)
+
+_MIX = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def hash_u128(key_lo, key_hi, cap_log2: int):
+    """splitmix64 finalizer over a mix of both limbs -> slot in [0, 2^cap_log2)."""
+    x = key_lo ^ (key_hi * _MIX)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> jnp.uint64(31))
+    return (x & jnp.uint64((1 << cap_log2) - 1)).astype(I32)
+
+
+def lookup(key_lo, key_hi, tbl_key_lo, tbl_key_hi, cap_log2: int):
+    """Batched (or scalar) probe. Returns (slot i32, found bool).
+
+    When not found, `slot` is the first empty slot of the probe chain (or an
+    arbitrary probed slot if the scan hit the probe bound) — callers must gate
+    on `found` and use dedicated insertion for writes.
+    """
+    mask = jnp.int32((1 << cap_log2) - 1)
+    idx = hash_u128(key_lo, key_hi, cap_log2)
+    # A key equal to the empty/tombstone encodings must never "hit".
+    key_probeable = ~((key_lo == EMPTY) & (key_hi == EMPTY)) & ~(
+        (key_lo == TOMB) & (key_hi == TOMB)
+    )
+    done0 = jnp.zeros_like(key_probeable, dtype=bool) & False
+    found0 = jnp.zeros_like(done0)
+    steps0 = jnp.int32(0)
+
+    def cond(carry):
+        _, done, _, steps = carry
+        return (~jnp.all(done)) & (steps <= mask)
+
+    def body(carry):
+        idx, done, found, steps = carry
+        k_lo = tbl_key_lo[idx]
+        k_hi = tbl_key_hi[idx]
+        hit = (k_lo == key_lo) & (k_hi == key_hi) & key_probeable
+        empty = (k_lo == EMPTY) & (k_hi == EMPTY)
+        newly = ~done & (hit | empty)
+        found = jnp.where(newly, hit, found)
+        done = done | newly
+        idx = jnp.where(done, idx, (idx + 1) & mask)
+        return idx, done, found, steps + 1
+
+    idx, _, found, _ = jax.lax.while_loop(cond, body, (idx, done0, found0, steps0))
+    return idx, found
+
+
+def insert_slots(key_lo, key_hi, active, tbl_key_lo, tbl_key_hi, claim, cap_log2: int):
+    """Claim one distinct slot per active lane for batch-unique, absent keys.
+
+    Returns (slots i32 [B] — dump slot for inactive lanes, tbl_key_lo',
+    tbl_key_hi', claim'). Races between lanes probing the same slot are
+    resolved deterministically by scatter-min of the lane index into the
+    persistent `claim` scratch column (reset to CLAIM_FREE before return).
+    Losing lanes observe the winner's key on the next iteration and probe on.
+    """
+    cap = 1 << cap_log2
+    mask = jnp.int32(cap - 1)
+    dump = jnp.int32(cap)
+    lanes = jnp.arange(key_lo.shape[0], dtype=U32)
+    idx = hash_u128(key_lo, key_hi, cap_log2)
+    done0 = ~active
+    steps0 = jnp.int32(0)
+
+    def cond(carry):
+        _, done, _, _, _, steps = carry
+        return (~jnp.all(done)) & (steps <= mask)
+
+    def body(carry):
+        idx, done, tk_lo, tk_hi, clm, steps = carry
+        k_lo = tk_lo[idx]
+        k_hi = tk_hi[idx]
+        free = ((k_lo == EMPTY) & (k_hi == EMPTY)) | ((k_lo == TOMB) & (k_hi == TOMB))
+        want = ~done & free
+        widx = jnp.where(want, idx, dump)
+        clm = clm.at[widx].min(lanes)
+        won = want & (clm[idx] == lanes)
+        clm = clm.at[widx].set(CLAIM_FREE)
+        sidx = jnp.where(won, idx, dump)
+        tk_lo = tk_lo.at[sidx].set(jnp.where(won, key_lo, tk_lo[sidx]))
+        tk_hi = tk_hi.at[sidx].set(jnp.where(won, key_hi, tk_hi[sidx]))
+        done = done | won
+        idx = jnp.where(done, idx, (idx + 1) & mask)
+        return idx, done, tk_lo, tk_hi, clm, steps + 1
+
+    idx, done, tbl_key_lo, tbl_key_hi, claim, _ = jax.lax.while_loop(
+        cond, body, (idx, done0, tbl_key_lo, tbl_key_hi, claim, steps0)
+    )
+    slots = jnp.where(active & done, idx, dump)
+    return slots, tbl_key_lo, tbl_key_hi, claim
+
+
+def probe_free_scalar(key_lo, key_hi, tbl_key_lo, tbl_key_hi, cap_log2: int):
+    """Read-only scalar probe to the first free (empty or tombstone) slot of
+    the key's probe chain (for the serial scan kernel, which masks its own
+    writes). The key must be absent from the table."""
+    mask = jnp.int32((1 << cap_log2) - 1)
+    idx = hash_u128(key_lo, key_hi, cap_log2)
+
+    def cond(carry):
+        idx, steps = carry
+        k_lo = tbl_key_lo[idx]
+        k_hi = tbl_key_hi[idx]
+        free = ((k_lo == EMPTY) & (k_hi == EMPTY)) | ((k_lo == TOMB) & (k_hi == TOMB))
+        return (~free) & (steps <= mask)
+
+    def body(carry):
+        idx, steps = carry
+        return (idx + 1) & mask, steps + 1
+
+    idx, _ = jax.lax.while_loop(cond, body, (idx, jnp.int32(0)))
+    return idx

@@ -1,0 +1,96 @@
+"""Exact u128 arithmetic as two u64 limbs (lo, hi) on device.
+
+The reference's amounts/balances are u128 with precise overflow semantics
+(reference: src/state_machine.zig:848-862, src/tigerbeetle.zig:7-40). TPUs have
+no native 128-bit integers, so every u128 is a pair of u64 arrays; all helpers
+are shape-polymorphic (work on scalars and batches alike) and return explicit
+carry/borrow bits where overflow matters.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+U64 = jnp.uint64
+U32 = jnp.uint32
+
+_ONE = jnp.uint64(1)
+_ZERO = jnp.uint64(0)
+
+
+def add(a_lo, a_hi, b_lo, b_hi):
+    """(a + b) mod 2^128 with carry-out. Returns (lo, hi, carry_out bool)."""
+    lo = a_lo + b_lo
+    c0 = lo < a_lo
+    hi0 = a_hi + b_hi
+    c1 = hi0 < a_hi
+    hi = hi0 + c0.astype(U64)
+    c2 = hi < hi0
+    return lo, hi, c1 | c2
+
+
+def add_u64(a_lo, a_hi, b):
+    """(a + b) for u64 b, with carry-out."""
+    return add(a_lo, a_hi, b, jnp.zeros_like(b))
+
+
+def sub(a_lo, a_hi, b_lo, b_hi):
+    """(a - b) mod 2^128 with borrow-out (True iff a < b)."""
+    lo = a_lo - b_lo
+    brw0 = a_lo < b_lo
+    hi0 = a_hi - b_hi
+    brw1 = a_hi < b_hi
+    hi = hi0 - brw0.astype(U64)
+    brw2 = hi > hi0  # wrapped below zero
+    return lo, hi, brw1 | brw2
+
+
+def sat_sub(a_lo, a_hi, b_lo, b_hi):
+    """max(0, a - b) (saturating subtract)."""
+    lo, hi, brw = sub(a_lo, a_hi, b_lo, b_hi)
+    return jnp.where(brw, _ZERO, lo), jnp.where(brw, _ZERO, hi)
+
+
+def eq(a_lo, a_hi, b_lo, b_hi):
+    return (a_lo == b_lo) & (a_hi == b_hi)
+
+
+def lt(a_lo, a_hi, b_lo, b_hi):
+    return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo < b_lo))
+
+
+def gt(a_lo, a_hi, b_lo, b_hi):
+    return lt(b_lo, b_hi, a_lo, a_hi)
+
+
+def le(a_lo, a_hi, b_lo, b_hi):
+    return ~gt(a_lo, a_hi, b_lo, b_hi)
+
+
+def is_zero(a_lo, a_hi):
+    return (a_lo == _ZERO) & (a_hi == _ZERO)
+
+
+def is_max(a_lo, a_hi):
+    m = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    return (a_lo == m) & (a_hi == m)
+
+
+def min_(a_lo, a_hi, b_lo, b_hi):
+    a_less = lt(a_lo, a_hi, b_lo, b_hi)
+    return jnp.where(a_less, a_lo, b_lo), jnp.where(a_less, a_hi, b_hi)
+
+
+def select(pred, a_lo, a_hi, b_lo, b_hi):
+    return jnp.where(pred, a_lo, b_lo), jnp.where(pred, a_hi, b_hi)
+
+
+def sum_overflows(a_lo, a_hi, b_lo, b_hi):
+    """reference: src/state_machine.zig:1152-1157 (u128 instantiation)."""
+    _, _, carry = add(a_lo, a_hi, b_lo, b_hi)
+    return carry
+
+
+def sum_overflows_u64(a, b):
+    """reference: src/state_machine.zig:1152-1157 (u64 instantiation)."""
+    return (a + b) < a

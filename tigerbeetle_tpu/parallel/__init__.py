@@ -1,0 +1,1 @@
+from tigerbeetle_tpu.parallel import mesh  # noqa: F401
