@@ -1,9 +1,12 @@
 """The device ledger: TigerBeetle's state machine as JAX kernels over HBM.
 
 This is the TPU-native redesign of the reference's hot path (reference:
-src/state_machine.zig:508-698 commit/execute): the account and transfer stores
-are HBM-resident open-addressing hash tables (ops/hashtable.py) and a whole
-prepare batch commits in one jitted step.
+src/state_machine.zig:508-698 commit/execute): the account and transfer
+stores are HBM-resident open-addressing hash tables whose rows ARE the
+128-byte wire format (one [capacity+1, 32] u32 array per table — see
+ops/hashtable.py for why u32 rows are the fast layout on TPU), and a whole
+prepare batch commits in one jitted step. Host batches upload as a single
+bitcast of the wire bytes.
 
 Two execution tiers live inside the same compiled function, dispatched by a
 device-computed hazard predicate via lax.cond:
@@ -14,9 +17,10 @@ device-computed hazard predicate via lax.cond:
   ids, no touched account with balance-limit flags, and no u128 overflow even
   at the batch-final balances (all fast-tier balance deltas are non-negative,
   so per-prefix overflow is impossible iff final overflow is). Balance deltas
-  are accumulated as 32-bit digit scatter-adds (sums of <= 2^13 events of
-  2^32-bounded digits fit u64 exactly) and carried into the u128 balances in
-  one elementwise renormalization pass.
+  accumulate as 16-bit digits in a persistent [capacity+1, 32] u32 scratch
+  (4 balance fields x 8 digits; digit sums of <= 2^13 events stay < 2^30), and
+  a touched-slot digit-carry pass folds them into the u128 balances — all in
+  u32, no big-array traffic.
 - **Serial tier (lax.scan)**: an exact, event-at-a-time kernel with the full
   semantics — linked-chain rollback via an undo log (reference:
   src/state_machine.zig:612-698 + src/lsm/groove.zig:990-1010 scopes),
@@ -27,7 +31,7 @@ Both tiers call the same validation ladders (models/validate.py), so result
 codes are bit-exact against the oracle (models/oracle.py) on every path.
 
 The reference's `posted` groove (reference: src/state_machine.zig:185-198) is
-the `fulfill` column of the pending transfer's row (1:1 by construction).
+the `fulfill` column alongside the transfer rows (1:1 by construction).
 """
 
 from __future__ import annotations
@@ -44,12 +48,7 @@ from tigerbeetle_tpu.constants import (
     ConfigProcess,
 )
 from tigerbeetle_tpu.models import validate
-from tigerbeetle_tpu.models.validate import (
-    F_LINKED,
-    F_PENDING,
-    F_POST,
-    F_VOID,
-)
+from tigerbeetle_tpu.models.validate import F_LINKED, F_PENDING, F_POST, F_VOID
 from tigerbeetle_tpu.ops import hashtable as ht
 from tigerbeetle_tpu.ops import u128
 from tigerbeetle_tpu.types import Operation
@@ -62,155 +61,208 @@ I32 = jnp.int32
 # balancing_credit). Only no-flag and pending-only events are fast-tier-safe.
 _SLOW_FLAGS = 0b111101
 
-_U64_COLS_ACCT = (
-    "key_lo", "key_hi",
-    "dp_lo", "dp_hi", "dpo_lo", "dpo_hi", "cp_lo", "cp_hi", "cpo_lo", "cpo_hi",
-    "ud128_lo", "ud128_hi", "ud64", "ts",
-)
-_U32_COLS_ACCT = ("ud32", "ledger", "code", "flags")
+ROW_WORDS = 32  # 128-byte wire rows as u32 words
 
-_U64_COLS_XFER = (
-    "key_lo", "key_hi",
-    "dr_lo", "dr_hi", "cr_lo", "cr_hi",
-    "amt_lo", "amt_hi", "pid_lo", "pid_hi",
-    "ud128_lo", "ud128_hi", "ud64", "ts",
-)
-_U32_COLS_XFER = ("ud32", "timeout", "ledger", "code", "flags", "fulfill")
 
-_BALANCE_COLS = ("dp", "dpo", "cp", "cpo")
+# ----------------------------------------------------------------------
+# wire-row pack/unpack (word offsets = byte offsets / 4 of the extern
+# structs, reference: src/tigerbeetle.zig:7-40 Account, :64-89 Transfer)
+# ----------------------------------------------------------------------
+
+
+def _w64(r, i: int):
+    return r[..., i].astype(U64) | (r[..., i + 1].astype(U64) << jnp.uint64(32))
+
+
+def _lohi(x):
+    return (x & jnp.uint64(0xFFFFFFFF)).astype(U32), (x >> jnp.uint64(32)).astype(U32)
+
+
+def unpack_transfer(r) -> dict:
+    return {
+        "id_lo": _w64(r, 0), "id_hi": _w64(r, 2),
+        "dr_lo": _w64(r, 4), "dr_hi": _w64(r, 6),
+        "cr_lo": _w64(r, 8), "cr_hi": _w64(r, 10),
+        "amt_lo": _w64(r, 12), "amt_hi": _w64(r, 14),
+        "pid_lo": _w64(r, 16), "pid_hi": _w64(r, 18),
+        "ud128_lo": _w64(r, 20), "ud128_hi": _w64(r, 22),
+        "ud64": _w64(r, 24),
+        "ud32": r[..., 26],
+        "timeout": r[..., 27],
+        "ledger": r[..., 28],
+        "code": r[..., 29] & jnp.uint32(0xFFFF),
+        "flags": r[..., 29] >> jnp.uint32(16),
+        "ts": _w64(r, 30),
+    }
+
+
+def pack_transfer(f) -> jnp.ndarray:
+    words = []
+    for key in ("id", "dr", "cr", "amt", "pid", "ud128"):
+        lo0, lo1 = _lohi(f[key + "_lo"])
+        hi0, hi1 = _lohi(f[key + "_hi"])
+        words += [lo0, lo1, hi0, hi1]
+    u0, u1 = _lohi(f["ud64"])
+    words += [u0, u1, f["ud32"], f["timeout"], f["ledger"],
+              (f["code"] & jnp.uint32(0xFFFF)) | (f["flags"] << jnp.uint32(16))]
+    t0, t1 = _lohi(f["ts"])
+    words += [t0, t1]
+    return jnp.stack(words, axis=-1)
+
+
+def unpack_account(r) -> dict:
+    return {
+        "id_lo": _w64(r, 0), "id_hi": _w64(r, 2),
+        "dp_lo": _w64(r, 4), "dp_hi": _w64(r, 6),
+        "dpo_lo": _w64(r, 8), "dpo_hi": _w64(r, 10),
+        "cp_lo": _w64(r, 12), "cp_hi": _w64(r, 14),
+        "cpo_lo": _w64(r, 16), "cpo_hi": _w64(r, 18),
+        "ud128_lo": _w64(r, 20), "ud128_hi": _w64(r, 22),
+        "ud64": _w64(r, 24),
+        "ud32": r[..., 26],
+        "reserved": r[..., 27],
+        "ledger": r[..., 28],
+        "code": r[..., 29] & jnp.uint32(0xFFFF),
+        "flags": r[..., 29] >> jnp.uint32(16),
+        "ts": _w64(r, 30),
+    }
+
+
+def pack_account(f) -> jnp.ndarray:
+    words = []
+    for key in ("id", "dp", "dpo", "cp", "cpo", "ud128"):
+        lo0, lo1 = _lohi(f[key + "_lo"])
+        hi0, hi1 = _lohi(f[key + "_hi"])
+        words += [lo0, lo1, hi0, hi1]
+    u0, u1 = _lohi(f["ud64"])
+    words += [u0, u1, f["ud32"], f["reserved"], f["ledger"],
+              (f["code"] & jnp.uint32(0xFFFF)) | (f["flags"] << jnp.uint32(16))]
+    t0, t1 = _lohi(f["ts"])
+    words += [t0, t1]
+    return jnp.stack(words, axis=-1)
+
+
+_TOMB_ROW = np.full(ROW_WORDS, 0xFFFFFFFF, dtype=np.uint32)
+
+
+def key4_from_fields(f):
+    lo0, lo1 = _lohi(f["id_lo"])
+    hi0, hi1 = _lohi(f["id_hi"])
+    return jnp.stack([lo0, lo1, hi0, hi1], axis=-1)
+
+
+# ----------------------------------------------------------------------
+# state
+# ----------------------------------------------------------------------
 
 
 def init_state(process: ConfigProcess = DEFAULT_PROCESS) -> dict:
-    """Allocate the device ledger state. Tables have capacity+1 rows: the last
-    row is the write dump for masked scatters (never read)."""
+    """Allocate the device ledger. Tables have capacity+1 rows: the last row
+    is the write dump for masked scatters (never read). `bal_acc` is the
+    persistent balance-digit accumulator (all-zero between commits)."""
     a_rows = (1 << process.account_slots_log2) + 1
     t_rows = (1 << process.transfer_slots_log2) + 1
-    acct = {c: jnp.zeros(a_rows, dtype=U64) for c in _U64_COLS_ACCT}
-    acct.update({c: jnp.zeros(a_rows, dtype=U32) for c in _U32_COLS_ACCT})
-    xfer = {c: jnp.zeros(t_rows, dtype=U64) for c in _U64_COLS_XFER}
-    xfer.update({c: jnp.zeros(t_rows, dtype=U32) for c in _U32_COLS_XFER})
     return {
-        "acct": acct,
-        "xfer": xfer,
+        "acct_rows": jnp.zeros((a_rows, ROW_WORDS), dtype=U32),
+        "xfer_rows": jnp.zeros((t_rows, ROW_WORDS), dtype=U32),
+        "fulfill": jnp.zeros(t_rows, dtype=U32),
         "acct_claim": jnp.full(a_rows, ht.CLAIM_FREE, dtype=U32),
         "xfer_claim": jnp.full(t_rows, ht.CLAIM_FREE, dtype=U32),
+        "bal_acc": jnp.zeros((a_rows, ROW_WORDS), dtype=U32),
         "commit_ts": jnp.uint64(0),
         "acct_count": jnp.uint64(0),
         "xfer_count": jnp.uint64(0),
     }
 
 
-def _row(tbl: dict, slot) -> dict:
-    return {k: v[slot] for k, v in tbl.items()}
+# ----------------------------------------------------------------------
+# host <-> device batch conversion (one bitcast upload)
+# ----------------------------------------------------------------------
 
 
-# --- host <-> device batch conversion ---
-
-
-def _pad(a: np.ndarray, n_pad: int) -> np.ndarray:
-    if len(a) == n_pad:
-        return a
-    out = np.zeros(n_pad, dtype=a.dtype)
-    out[: len(a)] = a
+def _to_rows_np(arr: np.ndarray, n_pad: int) -> np.ndarray:
+    out = np.zeros((n_pad, ROW_WORDS), dtype=np.uint32)
+    out[: len(arr)] = arr.view(np.uint32).reshape(len(arr), ROW_WORDS)
     return out
 
 
 def transfers_to_batch(arr: np.ndarray, n_pad: int) -> dict:
-    """Wire-format structured array (types.TRANSFER_DTYPE) -> SoA device batch."""
-    a = _pad(arr, n_pad)
-    return {
-        "id_lo": jnp.asarray(a["id_lo"]), "id_hi": jnp.asarray(a["id_hi"]),
-        "dr_lo": jnp.asarray(a["debit_account_id_lo"]),
-        "dr_hi": jnp.asarray(a["debit_account_id_hi"]),
-        "cr_lo": jnp.asarray(a["credit_account_id_lo"]),
-        "cr_hi": jnp.asarray(a["credit_account_id_hi"]),
-        "amt_lo": jnp.asarray(a["amount_lo"]), "amt_hi": jnp.asarray(a["amount_hi"]),
-        "pid_lo": jnp.asarray(a["pending_id_lo"]), "pid_hi": jnp.asarray(a["pending_id_hi"]),
-        "ud128_lo": jnp.asarray(a["user_data_128_lo"]),
-        "ud128_hi": jnp.asarray(a["user_data_128_hi"]),
-        "ud64": jnp.asarray(a["user_data_64"]),
-        "ud32": jnp.asarray(a["user_data_32"]),
-        "timeout": jnp.asarray(a["timeout"]),
-        "ledger": jnp.asarray(a["ledger"]),
-        "code": jnp.asarray(a["code"].astype(np.uint32)),
-        "flags": jnp.asarray(a["flags"].astype(np.uint32)),
-        "ts": jnp.asarray(a["timestamp"]),
-    }
+    """Wire-format structured array (types.TRANSFER_DTYPE) -> device batch."""
+    return {"rows": jnp.asarray(_to_rows_np(arr, n_pad))}
 
 
 def accounts_to_batch(arr: np.ndarray, n_pad: int) -> dict:
-    a = _pad(arr, n_pad)
-    return {
-        "id_lo": jnp.asarray(a["id_lo"]), "id_hi": jnp.asarray(a["id_hi"]),
-        "dp_lo": jnp.asarray(a["debits_pending_lo"]),
-        "dp_hi": jnp.asarray(a["debits_pending_hi"]),
-        "dpo_lo": jnp.asarray(a["debits_posted_lo"]),
-        "dpo_hi": jnp.asarray(a["debits_posted_hi"]),
-        "cp_lo": jnp.asarray(a["credits_pending_lo"]),
-        "cp_hi": jnp.asarray(a["credits_pending_hi"]),
-        "cpo_lo": jnp.asarray(a["credits_posted_lo"]),
-        "cpo_hi": jnp.asarray(a["credits_posted_hi"]),
-        "ud128_lo": jnp.asarray(a["user_data_128_lo"]),
-        "ud128_hi": jnp.asarray(a["user_data_128_hi"]),
-        "ud64": jnp.asarray(a["user_data_64"]),
-        "ud32": jnp.asarray(a["user_data_32"]),
-        "reserved": jnp.asarray(a["reserved"]),
-        "ledger": jnp.asarray(a["ledger"]),
-        "code": jnp.asarray(a["code"].astype(np.uint32)),
-        "flags": jnp.asarray(a["flags"].astype(np.uint32)),
-        "ts": jnp.asarray(a["timestamp"]),
-    }
+    return {"rows": jnp.asarray(_to_rows_np(arr, n_pad))}
 
 
 def ids_to_batch(ids: list[int], n_pad: int) -> dict:
-    lo = np.zeros(n_pad, dtype=np.uint64)
-    hi = np.zeros(n_pad, dtype=np.uint64)
+    k4 = np.zeros((n_pad, 4), dtype=np.uint32)
     for i, x in enumerate(ids):
-        lo[i], hi[i] = types.split_u128(x)
-    return {"id_lo": jnp.asarray(lo), "id_hi": jnp.asarray(hi)}
+        lo, hi = types.split_u128(x)
+        k4[i] = (lo & 0xFFFFFFFF, lo >> 32, hi & 0xFFFFFFFF, hi >> 32)
+    return {"key4": jnp.asarray(k4)}
 
 
-# --- duplicate-id detection (device) ---
+# ----------------------------------------------------------------------
+# helpers
+# ----------------------------------------------------------------------
 
 
-def _has_duplicate_ids(id_lo, id_hi, valid):
-    """True iff two valid lanes share an id. Invalid lanes sort last via a
-    third key and are excluded from adjacency comparison."""
+def _has_duplicate_ids(key4, valid):
+    """True iff two valid lanes share an id (exact; sorts the four u32 id
+    words — u32 sort keys are far cheaper than emulated-u64 ones on TPU).
+    Invalid lanes sort last via a leading key and are excluded."""
     inv = (~valid).astype(U32)
-    inv_s, hi_s, lo_s = jax.lax.sort((inv, id_hi, id_lo), num_keys=3)
-    both_valid = (inv_s[1:] == 0) & (inv_s[:-1] == 0)
-    dup = both_valid & (hi_s[1:] == hi_s[:-1]) & (lo_s[1:] == lo_s[:-1])
+    s = jax.lax.sort(
+        (inv, key4[:, 3], key4[:, 2], key4[:, 1], key4[:, 0]), num_keys=5
+    )
+    dup = (s[0][1:] == 0) & (s[0][:-1] == 0)
+    for a in s[1:]:
+        dup = dup & (a[1:] == a[:-1])
     return jnp.any(dup)
 
 
-# --- per-batch balance delta accumulation (fast tier) ---
+def _amount_digits(amt_lo, amt_hi):
+    """u128 -> 8 x 16-bit digits (u32 lanes), little-endian."""
+    ds = []
+    for limb in (amt_lo, amt_hi):
+        for j in range(4):
+            ds.append(((limb >> jnp.uint64(16 * j)) & jnp.uint64(0xFFFF)).astype(U32))
+    return jnp.stack(ds, axis=-1)  # [..., 8]
 
 
-def _digit_accumulate(n_rows, slot_masked_list, d0_list, d1_list):
-    """Scatter-add per-event u64 deltas as two 32-bit digits. Returns (acc0,
-    acc1) u64 accumulators of n_rows. Each event's delta fits u64 (fast tier
-    rejects amt_hi != 0); digit sums of <= 2^13 events fit u64 exactly."""
-    acc0 = jnp.zeros(n_rows, dtype=U64)
-    acc1 = jnp.zeros(n_rows, dtype=U64)
-    for slot, d0, d1 in zip(slot_masked_list, d0_list, d1_list):
-        acc0 = acc0.at[slot].add(d0)
-        acc1 = acc1.at[slot].add(d1)
-    return acc0, acc1
+def _fold_digits(row32, acc32):
+    """Fold a [.., 32] digit accumulator into a [.., 32] wire row's 4 balance
+    fields (words 4..19) with 16-bit carry propagation. acc lanes: dp digits
+    0..7, dpo 8..15, cp 16..23, cpo 24..31. Returns (new_row, overflow)."""
+    new_words = [row32[..., i] for i in range(ROW_WORDS)]
+    overflow = jnp.zeros(row32.shape[:-1], dtype=bool)
+    for field in range(4):  # dp, dpo, cp, cpo at words 4+4f .. 7+4f
+        w0 = 4 + 4 * field
+        carry = jnp.zeros(row32.shape[:-1], dtype=U32)
+        for k in range(4):  # 4 words x two 16-bit digits
+            w = row32[..., w0 + k]
+            d_lo = acc32[..., 8 * field + 2 * k]
+            d_hi = acc32[..., 8 * field + 2 * k + 1]
+            s_lo = (w & jnp.uint32(0xFFFF)) + d_lo + carry
+            carry = s_lo >> jnp.uint32(16)
+            s_hi = (w >> jnp.uint32(16)) + d_hi + carry
+            carry = s_hi >> jnp.uint32(16)
+            new_words[w0 + k] = (s_lo & jnp.uint32(0xFFFF)) | (s_hi << jnp.uint32(16))
+        overflow = overflow | (carry != 0)
+    return jnp.stack(new_words, axis=-1), overflow
 
 
-def _apply_digits(lo, hi, acc0, acc1):
-    """balance' = balance + (acc0 + acc1 * 2^32), exact, with overflow flag."""
-    thirty_two = jnp.uint64(32)
-    lo_add = acc0 + ((acc1 & jnp.uint64(0xFFFFFFFF)) << thirty_two)
-    carry1 = (lo_add < acc0).astype(U64)
-    hi_add = acc1 >> thirty_two
-    new_lo, new_hi, over_a = u128.add(lo, hi, lo_add, hi_add)
-    new_hi2 = new_hi + carry1
-    over_b = new_hi2 < new_hi
-    return new_lo, new_hi2, over_a | over_b
+def _set_ts_words(rows, ts):
+    t0, t1 = _lohi(ts)
+    return jnp.concatenate(
+        [rows[:, :30], t0[:, None], t1[:, None]], axis=1
+    )
 
 
-# --- kernel factory ---
+# ----------------------------------------------------------------------
+# kernels
+# ----------------------------------------------------------------------
 
 
 class LedgerKernels:
@@ -236,125 +288,113 @@ class LedgerKernels:
         self.lookup_accounts = jax.jit(self._lookup_accounts)
         self.lookup_transfers = jax.jit(self._lookup_transfers)
 
-    # -- shared lookups --
-
-    def _acct_lookup(self, acct, key_lo, key_hi):
-        return ht.lookup(key_lo, key_hi, acct["key_lo"], acct["key_hi"], self.a_log2)
-
-    def _xfer_lookup(self, xfer, key_lo, key_hi):
-        return ht.lookup(key_lo, key_hi, xfer["key_lo"], xfer["key_hi"], self.t_log2)
-
     # ------------------------------------------------------------------
     # create_transfers
     # ------------------------------------------------------------------
 
     def _commit_transfers(self, state, ev, n, timestamp, mode: str = "auto"):
         """Returns (state', results u32 [B])."""
-        B = ev["flags"].shape[0]
-        lane = jnp.arange(B, dtype=I32)
-        valid = lane < n
-        ts_vec = timestamp - n.astype(U64) + lane.astype(U64) + jnp.uint64(1)
-        ev_a = {**ev, "ts": ts_vec}  # timestamps assigned (reference: :645)
-
         if mode == "serial":
             return self._serial_transfers(state, ev, n, timestamp)
 
-        acct, xfer = state["acct"], state["xfer"]
-        dr_slot, dr_found = self._acct_lookup(acct, ev["dr_lo"], ev["dr_hi"])
-        cr_slot, cr_found = self._acct_lookup(acct, ev["cr_lo"], ev["cr_hi"])
-        ex_slot, ex_found = self._xfer_lookup(xfer, ev["id_lo"], ev["id_hi"])
-        dr = _row(acct, dr_slot)
-        cr = _row(acct, cr_slot)
-        ex = _row(xfer, ex_slot)
+        rows_b = ev["rows"]
+        B = rows_b.shape[0]
+        e = unpack_transfer(rows_b)
+        lane = jnp.arange(B, dtype=I32)
+        valid = lane < n
+        ts_vec = timestamp - n.astype(U64) + lane.astype(U64) + jnp.uint64(1)
+        e_a = {**e, "ts": ts_vec}
 
-        r0 = jnp.where(ev["ts"] != 0, jnp.uint32(3), jnp.uint32(0))
-        r0 = validate.transfer_common(ev, r0)
+        acct_rows = state["acct_rows"]
+        xfer_rows = state["xfer_rows"]
+        # dr and cr probe the same table: fuse into one 2B-lane lookup.
+        both_k4 = jnp.concatenate([rows_b[:, 4:8], rows_b[:, 8:12]], axis=0)
+        both_slot, both_found = ht.lookup(both_k4, acct_rows, self.a_log2)
+        both_rows = acct_rows[both_slot]
+        dr_slot, cr_slot = both_slot[:B], both_slot[B:]
+        dr_found, cr_found = both_found[:B], both_found[B:]
+        dr_row, cr_row = both_rows[:B], both_rows[B:]
+        ex_slot, ex_found = ht.lookup(rows_b[:, :4], xfer_rows, self.t_log2)
+        dr = unpack_account(dr_row)
+        cr = unpack_account(cr_row)
+        ex = unpack_transfer(xfer_rows[ex_slot])
+
+        r0 = jnp.where(e["ts"] != 0, jnp.uint32(3), jnp.uint32(0))
+        r0 = validate.transfer_common(e, r0)
         r, amt_lo, amt_hi = validate.validate_simple_transfer(
-            r0, ev_a, dr, cr, dr_found, cr_found, ex, ex_found
+            r0, e_a, dr, cr, dr_found, cr_found, ex, ex_found
         )
         r = jnp.where(valid, r, jnp.uint32(0))
         ok = valid & (r == 0)
 
         # Hazard predicate — any condition the vectorized tier cannot honor.
-        h_flags = jnp.any(valid & ((ev["flags"] & jnp.uint32(_SLOW_FLAGS)) != 0))
-        h_dup = _has_duplicate_ids(ev["id_lo"], ev["id_hi"], valid)
-        h_amt = jnp.any(ok & (ev["amt_hi"] != 0))
+        h_flags = jnp.any(valid & ((e["flags"] & jnp.uint32(_SLOW_FLAGS)) != 0))
+        h_dup = _has_duplicate_ids(rows_b[:, :4], valid)
         limit_bits = jnp.uint32(validate.A_DR_LIMIT | validate.A_CR_LIMIT)
         h_limit = jnp.any(ok & (((dr["flags"] | cr["flags"]) & limit_bits) != 0))
 
-        # Per-account batch totals as 32-bit digit scatter-adds.
-        pending = ok & ((ev["flags"] & jnp.uint32(F_PENDING)) != 0)
-        posted = ok & ~pending
-        mask32 = jnp.uint64(0xFFFFFFFF)
-        d0 = amt_lo & mask32
-        d1 = amt_lo >> jnp.uint64(32)
-        a_rows = (1 << self.a_log2) + 1
+        # Balance deltas: 16-bit digit scatter-add into the persistent
+        # accumulator, then a touched-slot carry fold. acc lane layout:
+        # dp 0..7 / dpo 8..15 / cp 16..23 / cpo 24..31.
+        digits = _amount_digits(amt_lo, amt_hi)  # [B, 8]
+        pending = ((e["flags"] & jnp.uint32(F_PENDING)) != 0)
+        zeros8 = jnp.zeros_like(digits)
+        pend8 = jnp.where(pending[:, None], digits, zeros8)
+        post8 = jnp.where(pending[:, None], zeros8, digits)
+        upd_dr = jnp.concatenate([pend8, post8, zeros8, zeros8], axis=-1)  # [B,32]
+        upd_cr = jnp.concatenate([zeros8, zeros8, pend8, post8], axis=-1)
+        slots_t = jnp.concatenate([
+            jnp.where(ok, dr_slot, self.a_dump),
+            jnp.where(ok, cr_slot, self.a_dump),
+        ])
+        upd = jnp.concatenate([upd_dr, upd_cr], axis=0)  # [2B, 32]
+        acc = state["bal_acc"].at[slots_t].add(upd)
+        acc_t = acc[slots_t]  # [2B, 32]
+        old_rows_t = jnp.concatenate([dr_row, cr_row], axis=0)
+        new_rows_t, over_t = _fold_digits(old_rows_t, acc_t)
+        h_overflow = jnp.any(over_t & (slots_t != self.a_dump))
+        acc = acc.at[slots_t].set(jnp.zeros_like(upd))  # restore all-zero
+        hazard = h_flags | h_dup | h_limit | h_overflow
 
-        def msk(cond, slot):
-            return jnp.where(cond, slot, self.a_dump)
-
-        new_bal = {}
-        overflow = jnp.zeros((), dtype=bool)
-        for col, cond, slot in (
-            ("dp", pending, dr_slot),
-            ("dpo", posted, dr_slot),
-            ("cp", pending, cr_slot),
-            ("cpo", posted, cr_slot),
-        ):
-            acc0, acc1 = _digit_accumulate(a_rows, [msk(cond, slot)], [d0], [d1])
-            lo, hi, over = _apply_digits(acct[col + "_lo"], acct[col + "_hi"], acc0, acc1)
-            new_bal[col + "_lo"] = lo
-            new_bal[col + "_hi"] = hi
-            overflow = overflow | jnp.any(over[: 1 << self.a_log2])
-        hazard = h_flags | h_dup | h_amt | h_limit | overflow
+        ins_rows = _set_ts_words(rows_b, ts_vec)
 
         def fast_branch(state):
-            acct2 = {**state["acct"], **new_bal}
-            xfer2 = dict(state["xfer"])
-            slots, k_lo, k_hi, claim = ht.insert_slots(
-                ev["id_lo"], ev["id_hi"], ok,
-                xfer2["key_lo"], xfer2["key_hi"], state["xfer_claim"], self.t_log2,
+            acct2 = state["acct_rows"].at[slots_t].set(new_rows_t)
+            slots, xfer2, claim = ht.insert_rows(
+                ins_rows, ok, state["xfer_rows"], state["xfer_claim"], self.t_log2
             )
-            xfer2["key_lo"], xfer2["key_hi"] = k_lo, k_hi
             w = jnp.where(ok, slots, self.t_dump)
-            for col, val in (
-                ("dr_lo", ev["dr_lo"]), ("dr_hi", ev["dr_hi"]),
-                ("cr_lo", ev["cr_lo"]), ("cr_hi", ev["cr_hi"]),
-                ("amt_lo", amt_lo), ("amt_hi", amt_hi),
-                ("pid_lo", ev["pid_lo"]), ("pid_hi", ev["pid_hi"]),
-                ("ud128_lo", ev["ud128_lo"]), ("ud128_hi", ev["ud128_hi"]),
-                ("ud64", ev["ud64"]), ("ud32", ev["ud32"]),
-                ("timeout", ev["timeout"]), ("ledger", ev["ledger"]),
-                ("code", ev["code"]), ("flags", ev["flags"]),
-                ("ts", ts_vec), ("fulfill", jnp.zeros_like(ev["ud32"])),
-            ):
-                xfer2[col] = xfer2[col].at[w].set(val)
+            fulfill = state["fulfill"].at[w].set(jnp.uint32(0))
             any_ok = jnp.any(ok)
             last_ts = jnp.max(jnp.where(ok, ts_vec, jnp.uint64(0)))
             return {
                 **state,
-                "acct": acct2,
-                "xfer": xfer2,
+                "acct_rows": acct2,
+                "xfer_rows": xfer2,
+                "fulfill": fulfill,
                 "xfer_claim": claim,
+                "bal_acc": acc,
                 "commit_ts": jnp.where(any_ok, last_ts, state["commit_ts"]),
                 "xfer_count": state["xfer_count"] + jnp.sum(ok).astype(U64),
             }, r
 
         if mode == "fast":
             return fast_branch(state)
-        return jax.lax.cond(
-            hazard,
-            lambda s: self._serial_transfers(s, ev, n, timestamp),
-            fast_branch,
-            state,
-        )
+
+        def serial_branch(state):
+            state2, results = self._serial_transfers(state, ev, n, timestamp)
+            return {**state2, "bal_acc": acc}, results
+
+        return jax.lax.cond(hazard, serial_branch, fast_branch, state)
 
     # -- exact serial tier --
 
     def _serial_transfers(self, state, ev, n, timestamp):
-        B = ev["flags"].shape[0]
+        rows_b = ev["rows"]
+        B = rows_b.shape[0]
         lanes = jnp.arange(B, dtype=I32)
         a_dump, t_dump = self.a_dump, self.t_dump
+        tomb_row = jnp.asarray(_TOMB_ROW)
 
         undo0 = {
             "kind": jnp.zeros(B, dtype=U32),
@@ -368,7 +408,7 @@ class LedgerKernels:
             "pa_hi": jnp.zeros(B, dtype=U64),
         }
         carry0 = (
-            state["acct"], state["xfer"],
+            state["acct_rows"], state["xfer_rows"], state["fulfill"],
             jnp.zeros(B, dtype=U32),  # results
             undo0,
             jnp.int32(-1),  # chain_start
@@ -377,8 +417,9 @@ class LedgerKernels:
         )
 
         def step(carry, x):
-            acct, xfer, results, undo, chain_start, chain_broken, commit_ts = carry
-            i, e = x
+            acct_rows, xfer_rows, fulfill, results, undo, chain_start, chain_broken, commit_ts = carry
+            i, row_e = x
+            e = unpack_transfer(row_e)
             active = i < n
             linked = active & ((e["flags"] & jnp.uint32(F_LINKED)) != 0)
 
@@ -396,20 +437,32 @@ class LedgerKernels:
             lad.set(e["ts"] != 0, 3)  # timestamp_must_be_zero
             r0 = validate.transfer_common(e, lad.r)
 
-            dr_slot, dr_found = self._acct_lookup(acct, e["dr_lo"], e["dr_hi"])
-            cr_slot, cr_found = self._acct_lookup(acct, e["cr_lo"], e["cr_hi"])
-            ex_slot, ex_found = self._xfer_lookup(xfer, e["id_lo"], e["id_hi"])
-            p_slot, p_found = self._xfer_lookup(xfer, e["pid_lo"], e["pid_hi"])
-            dr = _row(acct, dr_slot)
-            cr = _row(acct, cr_slot)
-            ex = _row(xfer, ex_slot)
-            p = _row(xfer, p_slot)
-            # The pending transfer's accounts (post/void path). Gated by
-            # p_found in the validator; garbage rows otherwise.
-            pdr_slot, _ = self._acct_lookup(acct, p["dr_lo"], p["dr_hi"])
-            pcr_slot, _ = self._acct_lookup(acct, p["cr_lo"], p["cr_hi"])
-            pdr = _row(acct, pdr_slot)
-            pcr = _row(acct, pcr_slot)
+            k4 = key4_from_fields
+            dr_slot, dr_found = ht.lookup(
+                k4({"id_lo": e["dr_lo"], "id_hi": e["dr_hi"]}), acct_rows, self.a_log2
+            )
+            cr_slot, cr_found = ht.lookup(
+                k4({"id_lo": e["cr_lo"], "id_hi": e["cr_hi"]}), acct_rows, self.a_log2
+            )
+            ex_slot, ex_found = ht.lookup(row_e[:4], xfer_rows, self.t_log2)
+            p_slot, p_found = ht.lookup(
+                k4({"id_lo": e["pid_lo"], "id_hi": e["pid_hi"]}), xfer_rows, self.t_log2
+            )
+            dr = unpack_account(acct_rows[dr_slot])
+            cr = unpack_account(acct_rows[cr_slot])
+            ex = unpack_transfer(xfer_rows[ex_slot])
+            p = unpack_transfer(xfer_rows[p_slot])
+            p["fulfill"] = fulfill[p_slot]
+            # The pending transfer's accounts (post/void path); garbage rows
+            # when ~p_found, gated by the validator.
+            pdr_slot, _ = ht.lookup(
+                k4({"id_lo": p["dr_lo"], "id_hi": p["dr_hi"]}), acct_rows, self.a_log2
+            )
+            pcr_slot, _ = ht.lookup(
+                k4({"id_lo": p["cr_lo"], "id_hi": p["cr_hi"]}), acct_rows, self.a_log2
+            )
+            pdr = unpack_account(acct_rows[pdr_slot])
+            pcr = unpack_account(acct_rows[pcr_slot])
 
             is_pv = (e["flags"] & jnp.uint32(F_POST | F_VOID)) != 0
             r_s, amt_s_lo, amt_s_hi = validate.validate_simple_transfer(
@@ -427,32 +480,22 @@ class LedgerKernels:
             is_post = is_pv & ((e["flags"] & jnp.uint32(F_POST)) != 0)
             is_pending = ~is_pv & ((e["flags"] & jnp.uint32(F_PENDING)) != 0)
 
-            # --- apply ---
-            free_slot = ht.probe_free_scalar(
-                e["id_lo"], e["id_hi"], xfer["key_lo"], xfer["key_hi"], self.t_log2
-            )
-            w = jnp.where(ok, free_slot, t_dump)
-            # Inserted row: the event itself (clamped amount), or the post/void
-            # fulfillment row t2 with p-defaulted fields (reference: :975-990).
-            zero64 = jnp.uint64(0)
-
-            def dflt(t_lo, t_hi, p_lo, p_hi):
+            # --- build the row to insert ---
+            def dflt128(t_lo, t_hi, p_lo, p_hi):
                 z = u128.is_zero(t_lo, t_hi)
                 return jnp.where(z, p_lo, t_lo), jnp.where(z, p_hi, t_hi)
 
-            t2_ud128_lo, t2_ud128_hi = dflt(
-                e["ud128_lo"], e["ud128_hi"], p["ud128_lo"], p["ud128_hi"]
-            )
-            row = {
-                "key_lo": e["id_lo"], "key_hi": e["id_hi"],
+            t2_ud128 = dflt128(e["ud128_lo"], e["ud128_hi"], p["ud128_lo"], p["ud128_hi"])
+            ins = {
+                "id_lo": e["id_lo"], "id_hi": e["id_hi"],
                 "dr_lo": jnp.where(is_pv, p["dr_lo"], e["dr_lo"]),
                 "dr_hi": jnp.where(is_pv, p["dr_hi"], e["dr_hi"]),
                 "cr_lo": jnp.where(is_pv, p["cr_lo"], e["cr_lo"]),
                 "cr_hi": jnp.where(is_pv, p["cr_hi"], e["cr_hi"]),
                 "amt_lo": amt_lo, "amt_hi": amt_hi,
                 "pid_lo": e["pid_lo"], "pid_hi": e["pid_hi"],
-                "ud128_lo": jnp.where(is_pv, t2_ud128_lo, e["ud128_lo"]),
-                "ud128_hi": jnp.where(is_pv, t2_ud128_hi, e["ud128_hi"]),
+                "ud128_lo": jnp.where(is_pv, t2_ud128[0], e["ud128_lo"]),
+                "ud128_hi": jnp.where(is_pv, t2_ud128[1], e["ud128_hi"]),
                 "ud64": jnp.where(is_pv & (e["ud64"] == 0), p["ud64"], e["ud64"]),
                 "ud32": jnp.where(is_pv & (e["ud32"] == 0), p["ud32"], e["ud32"]),
                 "timeout": jnp.where(is_pv, jnp.uint32(0), e["timeout"]),
@@ -460,21 +503,18 @@ class LedgerKernels:
                 "code": jnp.where(is_pv, p["code"], e["code"]),
                 "flags": e["flags"],
                 "ts": ts,
-                "fulfill": jnp.uint32(0),
             }
-            xfer = {k: v.at[w].set(row[k]) if k in row else v for k, v in xfer.items()}
-            # Write key columns too (probe_free_scalar does not write).
-            xfer["key_lo"] = xfer["key_lo"].at[w].set(e["id_lo"])
-            xfer["key_hi"] = xfer["key_hi"].at[w].set(e["id_hi"])
-            # Fulfillment mark on the pending row (posted groove insert,
-            # reference: :992-996).
+            ins_row = pack_transfer(ins)
+            free_slot = ht.probe_free_scalar(row_e[:4], xfer_rows, self.t_log2)
+            w = jnp.where(ok, free_slot, t_dump)
+            xfer_rows = xfer_rows.at[w].set(ins_row)
+            fulfill = fulfill.at[w].set(jnp.uint32(0))
             fw = jnp.where(ok & is_pv, p_slot, t_dump)
-            xfer["fulfill"] = xfer["fulfill"].at[fw].set(
+            fulfill = fulfill.at[fw].set(
                 jnp.where(is_post, jnp.uint32(1), jnp.uint32(2))
             )
 
-            # Balance application. Target accounts: the event's for simple,
-            # the pending transfer's for post/void. dr != cr guaranteed.
+            # --- balance application ---
             tgt_dr_slot = jnp.where(is_pv, pdr_slot, dr_slot)
             tgt_cr_slot = jnp.where(is_pv, pcr_slot, cr_slot)
             tdr = {k: jnp.where(is_pv, pdr[k], dr[k]) for k in dr}
@@ -491,30 +531,24 @@ class LedgerKernels:
                 return lo, hi
 
             false_ = jnp.zeros((), dtype=bool)
-            # debits_pending: +amt (pending create) / -p.amount (post|void)
-            dp_lo, dp_hi = upd(
+            zero64 = jnp.uint64(0)
+            dpo_add = (~is_pv & ~is_pending) | is_post
+            tdr["dp_lo"], tdr["dp_hi"] = upd(
                 tdr, "dp", is_pending, amt_lo, amt_hi, is_pv, p["amt_lo"], p["amt_hi"]
             )
-            # debits_posted: +amt (simple posted create, or post)
-            dpo_add = (~is_pv & ~is_pending) | is_post
-            dpo_lo, dpo_hi = upd(tdr, "dpo", dpo_add, amt_lo, amt_hi, false_, zero64, zero64)
-            cp_lo, cp_hi = upd(
+            tdr["dpo_lo"], tdr["dpo_hi"] = upd(
+                tdr, "dpo", dpo_add, amt_lo, amt_hi, false_, zero64, zero64
+            )
+            tcr["cp_lo"], tcr["cp_hi"] = upd(
                 tcr, "cp", is_pending, amt_lo, amt_hi, is_pv, p["amt_lo"], p["amt_hi"]
             )
-            cpo_lo, cpo_hi = upd(tcr, "cpo", dpo_add, amt_lo, amt_hi, false_, zero64, zero64)
-
+            tcr["cpo_lo"], tcr["cpo_hi"] = upd(
+                tcr, "cpo", dpo_add, amt_lo, amt_hi, false_, zero64, zero64
+            )
             dw = jnp.where(ok, tgt_dr_slot, a_dump)
             cw = jnp.where(ok, tgt_cr_slot, a_dump)
-            acct = dict(acct)
-            acct["dp_lo"] = acct["dp_lo"].at[dw].set(dp_lo)
-            acct["dp_hi"] = acct["dp_hi"].at[dw].set(dp_hi)
-            acct["dpo_lo"] = acct["dpo_lo"].at[dw].set(dpo_lo)
-            acct["dpo_hi"] = acct["dpo_hi"].at[dw].set(dpo_hi)
-            acct["cp_lo"] = acct["cp_lo"].at[cw].set(cp_lo)
-            acct["cp_hi"] = acct["cp_hi"].at[cw].set(cp_hi)
-            acct["cpo_lo"] = acct["cpo_lo"].at[cw].set(cpo_lo)
-            acct["cpo_hi"] = acct["cpo_hi"].at[cw].set(cpo_hi)
-
+            acct_rows = acct_rows.at[dw].set(pack_account(tdr))
+            acct_rows = acct_rows.at[cw].set(pack_account(tcr))
             commit_ts = jnp.where(ok, ts, commit_ts)
 
             # --- undo log entry ---
@@ -544,87 +578,74 @@ class LedgerKernels:
             lo_k = jnp.where(break_now, chain_start, i)
 
             def undo_body(k, tabs):
-                acct, xfer = tabs
+                acct_rows, xfer_rows, fulfill = tabs
                 kd = undo["kind"][k]
                 applied = kd != 0
-                k1 = kd == 1
-                k2 = kd == 2
-                k3 = kd == 3
-                k4 = kd == 4
+                k1, k2 = kd == 1, kd == 2
+                k3, k4_ = kd == 3, kd == 4
                 drs = undo["dr_slot"][k]
                 crs = undo["cr_slot"][k]
-                tsl = undo["t_slot"][k]
-                psl = undo["p_slot"][k]
                 ua_lo, ua_hi = undo["a_lo"][k], undo["a_hi"][k]
                 up_lo, up_hi = undo["pa_lo"][k], undo["pa_hi"][k]
+                add_p = k3 | k4_
+                sub_pend = k2
+                sub_post = k1 | k3
 
-                add_p = k3 | k4  # re-add p.amount to pending balances
-                sub_a_pend = k2  # remove pending-create amount
-                sub_a_post = k1 | k3  # remove posted amount
-
-                def inv(lo, hi, addc, sublo, subhi, subc):
+                def inv(fields, bal, addc, subc, s_lo, s_hi):
+                    lo, hi = fields[bal + "_lo"], fields[bal + "_hi"]
                     a_lo2, a_hi2, _ = u128.add(lo, hi, up_lo, up_hi)
                     lo = jnp.where(addc, a_lo2, lo)
                     hi = jnp.where(addc, a_hi2, hi)
-                    s_lo2, s_hi2, _ = u128.sub(lo, hi, sublo, subhi)
+                    s_lo2, s_hi2, _ = u128.sub(lo, hi, s_lo, s_hi)
                     lo = jnp.where(subc, s_lo2, lo)
                     hi = jnp.where(subc, s_hi2, hi)
                     return lo, hi
 
-                dpl, dph = inv(
-                    acct["dp_lo"][drs], acct["dp_hi"][drs], add_p, ua_lo, ua_hi, sub_a_pend
-                )
-                dpol, dpoh = inv(
-                    acct["dpo_lo"][drs], acct["dpo_hi"][drs], false_, ua_lo, ua_hi, sub_a_post
-                )
-                cpl, cph = inv(
-                    acct["cp_lo"][crs], acct["cp_hi"][crs], add_p, ua_lo, ua_hi, sub_a_pend
-                )
-                cpol, cpoh = inv(
-                    acct["cpo_lo"][crs], acct["cpo_hi"][crs], false_, ua_lo, ua_hi, sub_a_post
-                )
+                fdr = unpack_account(acct_rows[drs])
+                fcr = unpack_account(acct_rows[crs])
+                fdr["dp_lo"], fdr["dp_hi"] = inv(fdr, "dp", add_p, sub_pend, ua_lo, ua_hi)
+                fdr["dpo_lo"], fdr["dpo_hi"] = inv(fdr, "dpo", false_, sub_post, ua_lo, ua_hi)
+                fcr["cp_lo"], fcr["cp_hi"] = inv(fcr, "cp", add_p, sub_pend, ua_lo, ua_hi)
+                fcr["cpo_lo"], fcr["cpo_hi"] = inv(fcr, "cpo", false_, sub_post, ua_lo, ua_hi)
                 dwk = jnp.where(applied, drs, a_dump)
                 cwk = jnp.where(applied, crs, a_dump)
-                acct = dict(acct)
-                acct["dp_lo"] = acct["dp_lo"].at[dwk].set(dpl)
-                acct["dp_hi"] = acct["dp_hi"].at[dwk].set(dph)
-                acct["dpo_lo"] = acct["dpo_lo"].at[dwk].set(dpol)
-                acct["dpo_hi"] = acct["dpo_hi"].at[dwk].set(dpoh)
-                acct["cp_lo"] = acct["cp_lo"].at[cwk].set(cpl)
-                acct["cp_hi"] = acct["cp_hi"].at[cwk].set(cph)
-                acct["cpo_lo"] = acct["cpo_lo"].at[cwk].set(cpol)
-                acct["cpo_hi"] = acct["cpo_hi"].at[cwk].set(cpoh)
-                xfer = dict(xfer)
-                twk = jnp.where(applied, tsl, t_dump)
-                xfer["key_lo"] = xfer["key_lo"].at[twk].set(ht.TOMB)
-                xfer["key_hi"] = xfer["key_hi"].at[twk].set(ht.TOMB)
-                fwk = jnp.where(k3 | k4, psl, t_dump)
-                xfer["fulfill"] = xfer["fulfill"].at[fwk].set(jnp.uint32(0))
-                return acct, xfer
+                acct_rows = acct_rows.at[dwk].set(pack_account(fdr))
+                acct_rows = acct_rows.at[cwk].set(pack_account(fcr))
+                twk = jnp.where(applied, undo["t_slot"][k], t_dump)
+                xfer_rows = xfer_rows.at[twk].set(tomb_row)
+                fwk = jnp.where(k3 | k4_, undo["p_slot"][k], t_dump)
+                fulfill = fulfill.at[fwk].set(jnp.uint32(0))
+                return acct_rows, xfer_rows, fulfill
 
-            acct, xfer = jax.lax.fori_loop(lo_k, i, undo_body, (acct, xfer))
+            acct_rows, xfer_rows, fulfill = jax.lax.fori_loop(
+                lo_k, i, undo_body, (acct_rows, xfer_rows, fulfill)
+            )
 
             results = jnp.where(
                 break_now & (lanes >= chain_start) & (lanes < i), jnp.uint32(1), results
             )
             results = results.at[i].set(r)
-
             chain_broken = chain_broken | break_now
             chain_end = in_chain & (~linked | (r == 2))
             chain_start = jnp.where(chain_end, jnp.int32(-1), chain_start)
             chain_broken = jnp.where(chain_end, False, chain_broken)
 
-            return (acct, xfer, results, undo, chain_start, chain_broken, commit_ts), None
+            return (
+                acct_rows, xfer_rows, fulfill, results, undo,
+                chain_start, chain_broken, commit_ts,
+            ), None
 
-        xs = (lanes, ev)
-        (acct, xfer, results, _, _, _, commit_ts), _ = jax.lax.scan(step, carry0, xs)
+        (acct_rows, xfer_rows, fulfill, results, _, _, _, commit_ts), _ = jax.lax.scan(
+            step, carry0, (lanes, rows_b)
+        )
         ok_n = jnp.sum((results == 0) & (lanes < n)).astype(U64)
         # commit_ts advanced on at-the-time-ok events and, like the oracle's
         # scopes, is NOT restored by chain rollback — return the carry as-is.
         return {
             **state,
-            "acct": acct,
-            "xfer": xfer,
+            "acct_rows": acct_rows,
+            "xfer_rows": xfer_rows,
+            "fulfill": fulfill,
             "commit_ts": commit_ts,
             "xfer_count": state["xfer_count"] + ok_n,
         }, results
@@ -634,50 +655,37 @@ class LedgerKernels:
     # ------------------------------------------------------------------
 
     def _commit_accounts(self, state, ev, n, timestamp, mode: str = "auto"):
-        B = ev["flags"].shape[0]
+        if mode == "serial":
+            return self._serial_accounts(state, ev, n, timestamp)
+
+        rows_b = ev["rows"]
+        B = rows_b.shape[0]
+        e = unpack_account(rows_b)
         lane = jnp.arange(B, dtype=I32)
         valid = lane < n
         ts_vec = timestamp - n.astype(U64) + lane.astype(U64) + jnp.uint64(1)
 
-        if mode == "serial":
-            return self._serial_accounts(state, ev, n, timestamp)
-
-        acct = state["acct"]
-        ex_slot, ex_found = self._acct_lookup(acct, ev["id_lo"], ev["id_hi"])
-        ex = _row(acct, ex_slot)
-        r0 = jnp.where(ev["ts"] != 0, jnp.uint32(3), jnp.uint32(0))
-        r = validate.validate_create_account(r0, ev, ex, ex_found)
+        ex_slot, ex_found = ht.lookup(rows_b[:, :4], state["acct_rows"], self.a_log2)
+        ex = unpack_account(state["acct_rows"][ex_slot])
+        r0 = jnp.where(e["ts"] != 0, jnp.uint32(3), jnp.uint32(0))
+        r = validate.validate_create_account(r0, e, ex, ex_found)
         r = jnp.where(valid, r, jnp.uint32(0))
         ok = valid & (r == 0)
 
-        h_flags = jnp.any(valid & ((ev["flags"] & jnp.uint32(F_LINKED)) != 0))
-        h_dup = _has_duplicate_ids(ev["id_lo"], ev["id_hi"], valid)
+        h_flags = jnp.any(valid & ((e["flags"] & jnp.uint32(validate.A_LINKED)) != 0))
+        h_dup = _has_duplicate_ids(rows_b[:, :4], valid)
         hazard = h_flags | h_dup
+        ins_rows = _set_ts_words(rows_b, ts_vec)
 
         def fast_branch(state):
-            acct2 = dict(state["acct"])
-            slots, k_lo, k_hi, claim = ht.insert_slots(
-                ev["id_lo"], ev["id_hi"], ok,
-                acct2["key_lo"], acct2["key_hi"], state["acct_claim"], self.a_log2,
+            slots, acct2, claim = ht.insert_rows(
+                ins_rows, ok, state["acct_rows"], state["acct_claim"], self.a_log2
             )
-            acct2["key_lo"], acct2["key_hi"] = k_lo, k_hi
-            w = jnp.where(ok, slots, self.a_dump)
-            for col, val in (
-                ("dp_lo", ev["dp_lo"]), ("dp_hi", ev["dp_hi"]),
-                ("dpo_lo", ev["dpo_lo"]), ("dpo_hi", ev["dpo_hi"]),
-                ("cp_lo", ev["cp_lo"]), ("cp_hi", ev["cp_hi"]),
-                ("cpo_lo", ev["cpo_lo"]), ("cpo_hi", ev["cpo_hi"]),
-                ("ud128_lo", ev["ud128_lo"]), ("ud128_hi", ev["ud128_hi"]),
-                ("ud64", ev["ud64"]), ("ud32", ev["ud32"]),
-                ("ledger", ev["ledger"]), ("code", ev["code"]),
-                ("flags", ev["flags"]), ("ts", ts_vec),
-            ):
-                acct2[col] = acct2[col].at[w].set(val)
             any_ok = jnp.any(ok)
             last_ts = jnp.max(jnp.where(ok, ts_vec, jnp.uint64(0)))
             return {
                 **state,
-                "acct": acct2,
+                "acct_rows": acct2,
                 "acct_claim": claim,
                 "commit_ts": jnp.where(any_ok, last_ts, state["commit_ts"]),
                 "acct_count": state["acct_count"] + jnp.sum(ok).astype(U64),
@@ -693,16 +701,18 @@ class LedgerKernels:
         )
 
     def _serial_accounts(self, state, ev, n, timestamp):
-        B = ev["flags"].shape[0]
+        rows_b = ev["rows"]
+        B = rows_b.shape[0]
         lanes = jnp.arange(B, dtype=I32)
         a_dump = self.a_dump
+        tomb_row = jnp.asarray(_TOMB_ROW)
 
         undo0 = {
             "slot": jnp.zeros(B, dtype=I32),
             "kind": jnp.zeros(B, dtype=U32),
         }
         carry0 = (
-            state["acct"],
+            state["acct_rows"],
             jnp.zeros(B, dtype=U32),
             undo0,
             jnp.int32(-1),
@@ -711,8 +721,9 @@ class LedgerKernels:
         )
 
         def step(carry, x):
-            acct, results, undo, chain_start, chain_broken, commit_ts = carry
-            i, e = x
+            acct_rows, results, undo, chain_start, chain_broken, commit_ts = carry
+            i, row_e = x
+            e = unpack_account(row_e)
             active = i < n
             linked = active & ((e["flags"] & jnp.uint32(F_LINKED)) != 0)
             opening = linked & (chain_start < 0)
@@ -726,29 +737,17 @@ class LedgerKernels:
             lad.set(active & chain_broken, 1)
             lad.set(e["ts"] != 0, 3)
 
-            ex_slot, ex_found = self._acct_lookup(acct, e["id_lo"], e["id_hi"])
-            ex = _row(acct, ex_slot)
+            ex_slot, ex_found = ht.lookup(row_e[:4], acct_rows, self.a_log2)
+            ex = unpack_account(acct_rows[ex_slot])
             r = validate.validate_create_account(lad.r, e, ex, ex_found)
             r = jnp.where(active, r, jnp.uint32(0))
             ok = active & (r == 0)
 
-            free_slot = ht.probe_free_scalar(
-                e["id_lo"], e["id_hi"], acct["key_lo"], acct["key_hi"], self.a_log2
-            )
+            free_slot = ht.probe_free_scalar(row_e[:4], acct_rows, self.a_log2)
             w = jnp.where(ok, free_slot, a_dump)
-            acct = dict(acct)
-            for col, val in (
-                ("key_lo", e["id_lo"]), ("key_hi", e["id_hi"]),
-                ("dp_lo", e["dp_lo"]), ("dp_hi", e["dp_hi"]),
-                ("dpo_lo", e["dpo_lo"]), ("dpo_hi", e["dpo_hi"]),
-                ("cp_lo", e["cp_lo"]), ("cp_hi", e["cp_hi"]),
-                ("cpo_lo", e["cpo_lo"]), ("cpo_hi", e["cpo_hi"]),
-                ("ud128_lo", e["ud128_lo"]), ("ud128_hi", e["ud128_hi"]),
-                ("ud64", e["ud64"]), ("ud32", e["ud32"]),
-                ("ledger", e["ledger"]), ("code", e["code"]),
-                ("flags", e["flags"]), ("ts", ts),
-            ):
-                acct[col] = acct[col].at[w].set(val)
+            t0, t1 = _lohi(ts)
+            ins_row = jnp.concatenate([row_e[:30], t0[None], t1[None]])
+            acct_rows = acct_rows.at[w].set(ins_row)
             commit_ts = jnp.where(ok, ts, commit_ts)
 
             undo = {
@@ -759,15 +758,12 @@ class LedgerKernels:
             break_now = active & (r != 0) & in_chain & ~chain_broken
             lo_k = jnp.where(break_now, chain_start, i)
 
-            def undo_body(k, acct):
+            def undo_body(k, acct_rows):
                 applied = undo["kind"][k] != 0
                 sl = jnp.where(applied, undo["slot"][k], a_dump)
-                acct = dict(acct)
-                acct["key_lo"] = acct["key_lo"].at[sl].set(ht.TOMB)
-                acct["key_hi"] = acct["key_hi"].at[sl].set(ht.TOMB)
-                return acct
+                return acct_rows.at[sl].set(tomb_row)
 
-            acct = jax.lax.fori_loop(lo_k, i, undo_body, acct)
+            acct_rows = jax.lax.fori_loop(lo_k, i, undo_body, acct_rows)
             results = jnp.where(
                 break_now & (lanes >= chain_start) & (lanes < i), jnp.uint32(1), results
             )
@@ -776,13 +772,15 @@ class LedgerKernels:
             chain_end = in_chain & (~linked | (r == 2))
             chain_start = jnp.where(chain_end, jnp.int32(-1), chain_start)
             chain_broken = jnp.where(chain_end, False, chain_broken)
-            return (acct, results, undo, chain_start, chain_broken, commit_ts), None
+            return (acct_rows, results, undo, chain_start, chain_broken, commit_ts), None
 
-        (acct, results, _, _, _, commit_ts), _ = jax.lax.scan(step, carry0, (lanes, ev))
+        (acct_rows, results, _, _, _, commit_ts), _ = jax.lax.scan(
+            step, carry0, (lanes, rows_b)
+        )
         ok_n = jnp.sum((results == 0) & (lanes < n)).astype(U64)
         return {
             **state,
-            "acct": acct,
+            "acct_rows": acct_rows,
             "commit_ts": commit_ts,
             "acct_count": state["acct_count"] + ok_n,
         }, results
@@ -792,12 +790,12 @@ class LedgerKernels:
     # ------------------------------------------------------------------
 
     def _lookup_accounts(self, state, ids):
-        slot, found = self._acct_lookup(state["acct"], ids["id_lo"], ids["id_hi"])
-        return found, _row(state["acct"], slot)
+        slot, found = ht.lookup(ids["key4"], state["acct_rows"], self.a_log2)
+        return found, state["acct_rows"][slot]
 
     def _lookup_transfers(self, state, ids):
-        slot, found = self._xfer_lookup(state["xfer"], ids["id_lo"], ids["id_hi"])
-        return found, _row(state["xfer"], slot)
+        slot, found = ht.lookup(ids["key4"], state["xfer_rows"], self.t_log2)
+        return found, state["xfer_rows"][slot]
 
 
 # ----------------------------------------------------------------------
@@ -851,7 +849,7 @@ class DeviceLedger:
         dense = self.execute_dense(operation, timestamp, events)
         return [(i, c) for i, c in enumerate(dense) if c]
 
-    def execute_dense(self, operation, timestamp: int, events: list) -> list[int]:
+    def execute_dense(self, operation, timestamp: int, events) -> list[int]:
         n = len(events)
         n_pad = self._pad_for(n)
         assert n <= n_pad
@@ -891,48 +889,50 @@ class DeviceLedger:
             self._acct_used += ok_n
         return dense
 
-    def lookup_accounts(self, ids: list[int]) -> list[types.Account]:
+    def _lookup(self, kernel, ids: list[int]):
         n_pad = self._pad_for(len(ids))
-        found, rows = self.kernels.lookup_accounts(self.state, ids_to_batch(ids, n_pad))
+        found, rows = kernel(self.state, ids_to_batch(ids, n_pad))
         found = np.asarray(found)[: len(ids)]
-        rows = {k: np.asarray(v)[: len(ids)] for k, v in rows.items()}
-        out = []
-        for i in range(len(ids)):
-            if found[i]:
-                out.append(_account_from_cols(rows, i))
-        return out
+        rows = np.asarray(rows)[: len(ids)]
+        return found, rows
+
+    def lookup_accounts(self, ids: list[int]) -> list[types.Account]:
+        found, rows = self._lookup(self.kernels.lookup_accounts, ids)
+        structured = rows.tobytes()
+        arr = np.frombuffer(structured, dtype=types.ACCOUNT_DTYPE)
+        return [types.Account.from_np(arr[i]) for i in range(len(ids)) if found[i]]
 
     def lookup_transfers(self, ids: list[int]) -> list[types.Transfer]:
-        n_pad = self._pad_for(len(ids))
-        found, rows = self.kernels.lookup_transfers(self.state, ids_to_batch(ids, n_pad))
-        found = np.asarray(found)[: len(ids)]
-        rows = {k: np.asarray(v)[: len(ids)] for k, v in rows.items()}
-        out = []
-        for i in range(len(ids)):
-            if found[i]:
-                out.append(_transfer_from_cols(rows, i))
-        return out
+        found, rows = self._lookup(self.kernels.lookup_transfers, ids)
+        arr = np.frombuffer(rows.tobytes(), dtype=types.TRANSFER_DTYPE)
+        return [types.Transfer.from_np(arr[i]) for i in range(len(ids)) if found[i]]
 
     # -- parity extraction --
 
     def extract(self):
         """Pull the full device state to host dicts (accounts, transfers,
         posted) for bit-exact comparison against the oracle."""
-        acct = {k: np.asarray(v) for k, v in self.state["acct"].items()}
-        xfer = {k: np.asarray(v) for k, v in self.state["xfer"].items()}
+        acct_rows = np.asarray(self.state["acct_rows"])[:-1]
+        xfer_rows = np.asarray(self.state["xfer_rows"])[:-1]
+        fulfill = np.asarray(self.state["fulfill"])[:-1]
+
         accounts: dict[int, types.Account] = {}
         transfers: dict[int, types.Transfer] = {}
         posted: dict[int, int] = {}
-        occ_a = _occupied(acct)
-        for i in np.nonzero(occ_a)[0]:
-            a = _account_from_cols(acct, i)
+
+        occ = _occupied_rows(acct_rows)
+        arr = np.frombuffer(acct_rows[occ].tobytes(), dtype=types.ACCOUNT_DTYPE)
+        for i in range(len(arr)):
+            a = types.Account.from_np(arr[i])
             accounts[a.id] = a
-        occ_t = _occupied(xfer)
-        for i in np.nonzero(occ_t)[0]:
-            t = _transfer_from_cols(xfer, i)
+        occ = _occupied_rows(xfer_rows)
+        arr = np.frombuffer(xfer_rows[occ].tobytes(), dtype=types.TRANSFER_DTYPE)
+        ful = fulfill[occ]
+        for i in range(len(arr)):
+            t = types.Transfer.from_np(arr[i])
             transfers[t.id] = t
-            if xfer["fulfill"][i]:
-                posted[int(xfer["ts"][i])] = int(xfer["fulfill"][i])
+            if ful[i]:
+                posted[t.timestamp] = int(ful[i])
         return accounts, transfers, posted
 
     @property
@@ -940,45 +940,8 @@ class DeviceLedger:
         return int(self.state["commit_ts"])
 
 
-def _occupied(cols) -> np.ndarray:
-    k_lo, k_hi = cols["key_lo"], cols["key_hi"]
-    empty = (k_lo == 0) & (k_hi == 0)
-    tomb = (k_lo == np.uint64(0xFFFFFFFFFFFFFFFF)) & (k_hi == np.uint64(0xFFFFFFFFFFFFFFFF))
-    occ = ~empty & ~tomb
-    occ[-1] = False  # dump row
-    return occ
-
-
-def _account_from_cols(c, i) -> types.Account:
-    return types.Account(
-        id=types.join_u128(c["key_lo"][i], c["key_hi"][i]),
-        debits_pending=types.join_u128(c["dp_lo"][i], c["dp_hi"][i]),
-        debits_posted=types.join_u128(c["dpo_lo"][i], c["dpo_hi"][i]),
-        credits_pending=types.join_u128(c["cp_lo"][i], c["cp_hi"][i]),
-        credits_posted=types.join_u128(c["cpo_lo"][i], c["cpo_hi"][i]),
-        user_data_128=types.join_u128(c["ud128_lo"][i], c["ud128_hi"][i]),
-        user_data_64=int(c["ud64"][i]),
-        user_data_32=int(c["ud32"][i]),
-        ledger=int(c["ledger"][i]),
-        code=int(c["code"][i]),
-        flags=int(c["flags"][i]),
-        timestamp=int(c["ts"][i]),
-    )
-
-
-def _transfer_from_cols(c, i) -> types.Transfer:
-    return types.Transfer(
-        id=types.join_u128(c["key_lo"][i], c["key_hi"][i]),
-        debit_account_id=types.join_u128(c["dr_lo"][i], c["dr_hi"][i]),
-        credit_account_id=types.join_u128(c["cr_lo"][i], c["cr_hi"][i]),
-        amount=types.join_u128(c["amt_lo"][i], c["amt_hi"][i]),
-        pending_id=types.join_u128(c["pid_lo"][i], c["pid_hi"][i]),
-        user_data_128=types.join_u128(c["ud128_lo"][i], c["ud128_hi"][i]),
-        user_data_64=int(c["ud64"][i]),
-        user_data_32=int(c["ud32"][i]),
-        timeout=int(c["timeout"][i]),
-        ledger=int(c["ledger"][i]),
-        code=int(c["code"][i]),
-        flags=int(c["flags"][i]),
-        timestamp=int(c["ts"][i]),
-    )
+def _occupied_rows(rows: np.ndarray) -> np.ndarray:
+    k4 = rows[:, :4]
+    empty = (k4 == 0).all(axis=1)
+    tomb = (k4 == 0xFFFFFFFF).all(axis=1)
+    return ~empty & ~tomb

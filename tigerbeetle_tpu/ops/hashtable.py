@@ -1,21 +1,25 @@
-"""HBM-resident open-addressing hash tables keyed by u128 ids.
+"""HBM-resident open-addressing hash tables over 128-byte wire-layout rows.
 
 This is the TPU-native replacement for the reference's Groove object store +
-CacheMap (reference: src/lsm/groove.zig:602-760, src/lsm/cache_map.zig): instead
-of an LSM-backed cache with async prefetch, the full working set lives in HBM
-as struct-of-arrays columns over `capacity + 1` slots. Slot `capacity` is a
-write dump for masked scatters (predicated lanes write there and the row is
-never read). Probing is linear with a batched while_loop: every lane gathers
-its candidate slot each iteration, so a batch of 8190 lookups costs
-O(max probe chain) gathers of the whole batch, not O(batch) serial probes.
+CacheMap (reference: src/lsm/groove.zig:602-760, src/lsm/cache_map.zig): the
+full working set lives in HBM as a single [capacity + 1, 32] u32 array per
+table, each row being the object's 128-byte little-endian wire format
+(reference: src/tigerbeetle.zig:7-104) — so a host batch uploads as one
+bitcast and a probe fetches a whole object in one gather.
 
-Key encoding:
-- empty slot:      key == (0, 0)        (valid ids are never 0)
-- tombstone slot:  key == (2^64-1, 2^64-1)  (valid ids are never u128 max;
-  both invariants are enforced by id_must_not_be_zero / id_must_not_be_int_max,
+Why u32 rows: on TPU, XLA lowers 64-bit gathers/scatters to per-index scalar
+DMAs (~100us per op for an 8k batch), while u32 row gathers vectorize
+(~10us). All storage is u32; arithmetic widens to u64 limbs after gathering
+(elementwise widening is cheap).
+
+Slot `capacity` is a write dump for masked scatters (never read). Probing is
+linear with a batched while_loop. Key encoding in row words 0..3 (the id):
+- empty slot:     all four words 0  (valid ids are never 0)
+- tombstone slot: all four words 0xFFFFFFFF  (valid ids are never u128 max;
+  both invariants enforced by id_must_not_be_zero / id_must_not_be_int_max,
   reference: src/tigerbeetle.zig:118-121, 160-163)
-Tombstones arise only from linked-chain rollback deletions; lookups skip them,
-inserts reuse them.
+Tombstones arise only from linked-chain rollback deletions; lookups skip
+them, inserts reuse them.
 """
 
 from __future__ import annotations
@@ -27,116 +31,168 @@ U64 = jnp.uint64
 U32 = jnp.uint32
 I32 = jnp.int32
 
-EMPTY = jnp.uint64(0)
-TOMB = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+TOMB_WORD = jnp.uint32(0xFFFFFFFF)
 CLAIM_FREE = jnp.uint32(0xFFFFFFFF)
 
 _MIX = jnp.uint64(0x9E3779B97F4A7C15)
 
 
-def hash_u128(key_lo, key_hi, cap_log2: int):
-    """splitmix64 finalizer over a mix of both limbs -> slot in [0, 2^cap_log2)."""
-    x = key_lo ^ (key_hi * _MIX)
+def key4_of_rows(rows):
+    """The id words of wire rows (works for [N, 32] and [32])."""
+    return rows[..., :4]
+
+
+def hash_key4(key4, cap_log2: int):
+    """splitmix64 finalizer over both id limbs -> slot in [0, 2^cap_log2)."""
+    k = key4.astype(U64)
+    lo = k[..., 0] | (k[..., 1] << jnp.uint64(32))
+    hi = k[..., 2] | (k[..., 3] << jnp.uint64(32))
+    x = lo ^ (hi * _MIX)
     x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
     x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
     x = x ^ (x >> jnp.uint64(31))
     return (x & jnp.uint64((1 << cap_log2) - 1)).astype(I32)
 
 
-def lookup(key_lo, key_hi, tbl_key_lo, tbl_key_hi, cap_log2: int):
+def _key_eq(a4, b4):
+    return jnp.all(a4 == b4, axis=-1)
+
+
+def _is_empty(k4):
+    return jnp.all(k4 == 0, axis=-1)
+
+
+def _is_tomb(k4):
+    return jnp.all(k4 == TOMB_WORD, axis=-1)
+
+
+LOOKUP_UNROLL = 8
+
+
+def lookup(key4, rows, cap_log2: int, unroll: int = LOOKUP_UNROLL):
     """Batched (or scalar) probe. Returns (slot i32, found bool).
 
+    The first `unroll` probe steps are straight-line code (a TPU while_loop
+    costs ~0.3ms per iteration in scalar-core sync, so data-dependent trip
+    counts are poison for the common case); a while_loop continuation runs
+    under lax.cond only if some lane's chain is longer — vanishingly rare at
+    the enforced <= 7/8 load factor.
+
     When not found, `slot` is the first empty slot of the probe chain (or an
-    arbitrary probed slot if the scan hit the probe bound) — callers must gate
-    on `found` and use dedicated insertion for writes.
+    arbitrary probed slot if the scan hit the probe bound) — callers must
+    gate on `found`.
     """
     mask = jnp.int32((1 << cap_log2) - 1)
-    idx = hash_u128(key_lo, key_hi, cap_log2)
-    # A key equal to the empty/tombstone encodings must never "hit".
-    key_probeable = ~((key_lo == EMPTY) & (key_hi == EMPTY)) & ~(
-        (key_lo == TOMB) & (key_hi == TOMB)
-    )
-    done0 = jnp.zeros_like(key_probeable, dtype=bool) & False
-    found0 = jnp.zeros_like(done0)
-    steps0 = jnp.int32(0)
+    idx = hash_key4(key4, cap_log2)
+    key_probeable = ~_is_empty(key4) & ~_is_tomb(key4)
+    done = jnp.zeros(idx.shape, dtype=bool)
+    found = jnp.zeros(idx.shape, dtype=bool)
 
-    def cond(carry):
-        _, done, _, steps = carry
-        return (~jnp.all(done)) & (steps <= mask)
-
-    def body(carry):
-        idx, done, found, steps = carry
-        k_lo = tbl_key_lo[idx]
-        k_hi = tbl_key_hi[idx]
-        hit = (k_lo == key_lo) & (k_hi == key_hi) & key_probeable
-        empty = (k_lo == EMPTY) & (k_hi == EMPTY)
+    def probe_once(idx, done, found):
+        k4 = rows[idx, :4]  # key words only — 16B per probed slot
+        hit = _key_eq(k4, key4) & key_probeable
+        empty = _is_empty(k4)
         newly = ~done & (hit | empty)
         found = jnp.where(newly, hit, found)
         done = done | newly
         idx = jnp.where(done, idx, (idx + 1) & mask)
-        return idx, done, found, steps + 1
+        return idx, done, found
 
-    idx, _, found, _ = jax.lax.while_loop(cond, body, (idx, done0, found0, steps0))
+    for _ in range(min(unroll, 1 << cap_log2)):
+        idx, done, found = probe_once(idx, done, found)
+
+    def continuation(carry):
+        def cond(c):
+            _, done, _, steps = c
+            return (~jnp.all(done)) & (steps <= mask)
+
+        def body(c):
+            idx, done, found, steps = c
+            idx, done, found = probe_once(idx, done, found)
+            return idx, done, found, steps + 1
+
+        idx, done, found, _ = jax.lax.while_loop(
+            cond, body, (*carry, jnp.int32(0))
+        )
+        return idx, done, found
+
+    idx, _, found = jax.lax.cond(
+        jnp.all(done), lambda c: c, continuation, (idx, done, found)
+    )
     return idx, found
 
 
-def insert_slots(key_lo, key_hi, active, tbl_key_lo, tbl_key_hi, claim, cap_log2: int):
-    """Claim one distinct slot per active lane for batch-unique, absent keys.
+def insert_rows(row32, active, rows, claim, cap_log2: int):
+    """Claim one distinct slot per active lane and write the full 32-word row
+    there, for batch-unique, absent keys (id = row words 0..3).
 
-    Returns (slots i32 [B] — dump slot for inactive lanes, tbl_key_lo',
-    tbl_key_hi', claim'). Races between lanes probing the same slot are
-    resolved deterministically by scatter-min of the lane index into the
-    persistent `claim` scratch column (reset to CLAIM_FREE before return).
-    Losing lanes observe the winner's key on the next iteration and probe on.
+    Returns (slots i32 [B] — dump slot for inactive lanes, rows', claim').
+    Probe races between lanes are resolved deterministically by scatter-min of
+    the lane index into the persistent `claim` scratch column (reset to
+    CLAIM_FREE before return). Losing lanes observe the winner's key on the
+    next iteration and probe on.
     """
     cap = 1 << cap_log2
     mask = jnp.int32(cap - 1)
     dump = jnp.int32(cap)
-    lanes = jnp.arange(key_lo.shape[0], dtype=U32)
-    idx = hash_u128(key_lo, key_hi, cap_log2)
+    B = row32.shape[0]
+    lanes = jnp.arange(B, dtype=U32)
+    key4 = key4_of_rows(row32)
+    idx = hash_key4(key4, cap_log2)
     done0 = ~active
-    steps0 = jnp.int32(0)
 
-    def cond(carry):
-        _, done, _, _, _, steps = carry
-        return (~jnp.all(done)) & (steps <= mask)
-
-    def body(carry):
-        idx, done, tk_lo, tk_hi, clm, steps = carry
-        k_lo = tk_lo[idx]
-        k_hi = tk_hi[idx]
-        free = ((k_lo == EMPTY) & (k_hi == EMPTY)) | ((k_lo == TOMB) & (k_hi == TOMB))
-        want = ~done & free
-        widx = jnp.where(want, idx, dump)
-        clm = clm.at[widx].min(lanes)
+    # Claims are HELD across rounds as in-batch occupancy (claim[slot] != FREE
+    # means "taken by this batch"), so the table itself is never written during
+    # probing — each round is just three cheap u32 gathers/scatters. Every
+    # claimed slot has a winner, so the final reset at `slots` frees them all.
+    def claim_once(idx, done, clm):
+        k4 = rows[idx, :4]
+        table_free = _is_empty(k4) | _is_tomb(k4)
+        want = ~done & table_free & (clm[idx] == CLAIM_FREE)
+        clm = clm.at[jnp.where(want, idx, dump)].min(lanes)
         won = want & (clm[idx] == lanes)
-        clm = clm.at[widx].set(CLAIM_FREE)
-        sidx = jnp.where(won, idx, dump)
-        tk_lo = tk_lo.at[sidx].set(jnp.where(won, key_lo, tk_lo[sidx]))
-        tk_hi = tk_hi.at[sidx].set(jnp.where(won, key_hi, tk_hi[sidx]))
         done = done | won
         idx = jnp.where(done, idx, (idx + 1) & mask)
-        return idx, done, tk_lo, tk_hi, clm, steps + 1
+        return idx, done, clm
 
-    idx, done, tbl_key_lo, tbl_key_hi, claim, _ = jax.lax.while_loop(
-        cond, body, (idx, done0, tbl_key_lo, tbl_key_hi, claim, steps0)
+    idx, done, clm = (idx, done0, claim)
+    for _ in range(min(LOOKUP_UNROLL, 1 << cap_log2)):
+        idx, done, clm = claim_once(idx, done, clm)
+
+    def continuation(carry):
+        def cond(c):
+            _, done, _, steps = c
+            return (~jnp.all(done)) & (steps <= mask)
+
+        def body(c):
+            idx, done, clm, steps = c
+            idx, done, clm = claim_once(idx, done, clm)
+            return idx, done, clm, steps + 1
+
+        idx, done, clm, _ = jax.lax.while_loop(cond, body, (*carry, jnp.int32(0)))
+        return idx, done, clm
+
+    idx, done, clm = jax.lax.cond(
+        jnp.all(done), lambda c: c, continuation, (idx, done, clm)
     )
     slots = jnp.where(active & done, idx, dump)
-    return slots, tbl_key_lo, tbl_key_hi, claim
+    rows = rows.at[slots].set(row32)
+    # Reset won slots + the dump slot (non-want lanes min-scatter there).
+    claim = clm.at[slots].set(CLAIM_FREE).at[dump].set(CLAIM_FREE)
+    return slots, rows, claim
 
 
-def probe_free_scalar(key_lo, key_hi, tbl_key_lo, tbl_key_hi, cap_log2: int):
+def probe_free_scalar(key4, rows, cap_log2: int):
     """Read-only scalar probe to the first free (empty or tombstone) slot of
     the key's probe chain (for the serial scan kernel, which masks its own
     writes). The key must be absent from the table."""
     mask = jnp.int32((1 << cap_log2) - 1)
-    idx = hash_u128(key_lo, key_hi, cap_log2)
+    idx = hash_key4(key4, cap_log2)
 
     def cond(carry):
         idx, steps = carry
-        k_lo = tbl_key_lo[idx]
-        k_hi = tbl_key_hi[idx]
-        free = ((k_lo == EMPTY) & (k_hi == EMPTY)) | ((k_lo == TOMB) & (k_hi == TOMB))
+        k4 = key4_of_rows(rows[idx])
+        free = _is_empty(k4) | _is_tomb(k4)
         return (~free) & (steps <= mask)
 
     def body(carry):

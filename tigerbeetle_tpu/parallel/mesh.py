@@ -6,18 +6,18 @@ The TPU-native design shards the account and transfer hash tables across
 chips of ONE replica over ICI — consensus replication between replicas stays
 host-level and is orthogonal (SURVEY.md §5.8).
 
-Layout: every table column is [n_shards, local_rows] sharded on axis 0 over
-mesh axis "shard". A key's owner shard is a second, independent hash
+Layout: wire-row tables of [n_shards, local_rows, 32] u32 sharded on axis 0
+over mesh axis "shard". A key's owner shard is a second, independent hash
 (owner_u128); within the owner it probes that shard's local open-addressing
 table. A commit step runs under shard_map:
 
 1. Each shard probes its local tables for ALL lanes, masks hits by ownership,
-   and the per-lane rows are combined with psum over ICI (exactly one shard
-   contributes non-zero data per found lane).
-2. Validation (models/validate.py ladders) is computed replicated — it is pure
-   elementwise math over the psum'd rows, identical on every shard.
-3. Application is local: each shard scatter-applies balance deltas and row
-   inserts only for keys it owns.
+   and the per-lane 128-byte rows are combined with one psum over ICI
+   (exactly one shard contributes non-zero data per found lane).
+2. Validation (models/validate.py ladders) is computed replicated — it is
+   pure elementwise math over the psum'd rows, identical on every shard.
+3. Application is local: each shard digit-accumulates balance deltas and
+   inserts rows only for keys it owns.
 
 This multi-chip tier currently executes the vectorized fast path (no-flag and
 pending-only batches). Hazard batches (linked chains, post/void, balancing,
@@ -37,16 +37,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tigerbeetle_tpu.constants import ConfigProcess
 from tigerbeetle_tpu.models import validate
 from tigerbeetle_tpu.models.ledger import (
+    ROW_WORDS,
     _SLOW_FLAGS,
-    _U32_COLS_ACCT,
-    _U32_COLS_XFER,
-    _U64_COLS_ACCT,
-    _U64_COLS_XFER,
-    _apply_digits,
+    _amount_digits,
+    _fold_digits,
     _has_duplicate_ids,
     _next_pow2,
+    _set_ts_words,
     accounts_to_batch,
+    key4_from_fields,
     transfers_to_batch,
+    unpack_account,
+    unpack_transfer,
 )
 from tigerbeetle_tpu.models.validate import F_PENDING
 from tigerbeetle_tpu.ops import hashtable as ht
@@ -56,53 +58,44 @@ U64 = jnp.uint64
 U32 = jnp.uint32
 I32 = jnp.int32
 
-
 _OWNER_MIX = jnp.uint64(0xD6E8FEB86659FD93)
 
 
-def owner_u128(key_lo, key_hi, n_shards: int):
+def owner_of_key4(key4, n_shards: int):
     """Owner shard of a key — an independent hash from the slot hash."""
-    x = (key_lo ^ jnp.uint64(0xA5A5A5A5A5A5A5A5)) * _OWNER_MIX
-    x = x ^ (key_hi * _OWNER_MIX) ^ (x >> jnp.uint64(29))
+    k = key4.astype(U64)
+    lo = k[..., 0] | (k[..., 1] << jnp.uint64(32))
+    hi = k[..., 2] | (k[..., 3] << jnp.uint64(32))
+    x = (lo ^ jnp.uint64(0xA5A5A5A5A5A5A5A5)) * _OWNER_MIX
+    x = x ^ (hi * _OWNER_MIX) ^ (x >> jnp.uint64(29))
     x = x * jnp.uint64(0x94D049BB133111EB)
     x = x ^ (x >> jnp.uint64(32))
     return (x % jnp.uint64(n_shards)).astype(I32)
 
 
 def init_sharded_state(mesh: Mesh, process: ConfigProcess) -> dict:
-    """Tables of [n_shards, local_rows] sharded over mesh axis "shard".
+    """Tables of [n_shards, local_rows, 32] sharded over mesh axis "shard".
     local capacity = 2^account_slots_log2 etc. PER SHARD."""
     n = mesh.devices.size
     a_rows = (1 << process.account_slots_log2) + 1
     t_rows = (1 << process.transfer_slots_log2) + 1
-    sh = NamedSharding(mesh, P("shard", None))
+    sh = NamedSharding(mesh, P("shard"))
     sc = NamedSharding(mesh, P())
 
-    def col(rows, dt):
-        return jax.device_put(jnp.zeros((n, rows), dtype=dt), sh)
+    def put(x, s):
+        return jax.device_put(x, s)
 
-    acct = {c: col(a_rows, U64) for c in _U64_COLS_ACCT}
-    acct.update({c: col(a_rows, U32) for c in _U32_COLS_ACCT})
-    xfer = {c: col(t_rows, U64) for c in _U64_COLS_XFER}
-    xfer.update({c: col(t_rows, U32) for c in _U32_COLS_XFER})
     return {
-        "acct": acct,
-        "xfer": xfer,
-        "acct_claim": jax.device_put(jnp.full((n, a_rows), ht.CLAIM_FREE, dtype=U32), sh),
-        "xfer_claim": jax.device_put(jnp.full((n, t_rows), ht.CLAIM_FREE, dtype=U32), sh),
-        "commit_ts": jax.device_put(jnp.uint64(0), sc),
-        "acct_count": jax.device_put(jnp.uint64(0), sc),
-        "xfer_count": jax.device_put(jnp.uint64(0), sc),
+        "acct_rows": put(jnp.zeros((n, a_rows, ROW_WORDS), dtype=U32), sh),
+        "xfer_rows": put(jnp.zeros((n, t_rows, ROW_WORDS), dtype=U32), sh),
+        "fulfill": put(jnp.zeros((n, t_rows), dtype=U32), sh),
+        "acct_claim": put(jnp.full((n, a_rows), ht.CLAIM_FREE, dtype=U32), sh),
+        "xfer_claim": put(jnp.full((n, t_rows), ht.CLAIM_FREE, dtype=U32), sh),
+        "bal_acc": put(jnp.zeros((n, a_rows, ROW_WORDS), dtype=U32), sh),
+        "commit_ts": put(jnp.uint64(0), sc),
+        "acct_count": put(jnp.uint64(0), sc),
+        "xfer_count": put(jnp.uint64(0), sc),
     }
-
-
-def _psum_row(row: dict, contribute, axis: str) -> dict:
-    """Combine per-shard masked rows: exactly one shard contributes per lane."""
-    out = {}
-    for k, v in row.items():
-        masked = jnp.where(contribute, v, jnp.zeros_like(v))
-        out[k] = jax.lax.psum(masked, axis)
-    return out
 
 
 class ShardedLedgerKernels:
@@ -117,242 +110,183 @@ class ShardedLedgerKernels:
         self.a_dump = jnp.int32(1 << self.a_log2)
         self.t_dump = jnp.int32(1 << self.t_log2)
 
-        state_spec = jax.tree_util.tree_map(lambda _: P("shard", None), {
-            "acct": {c: 0 for c in (*_U64_COLS_ACCT, *_U32_COLS_ACCT)},
-            "xfer": {c: 0 for c in (*_U64_COLS_XFER, *_U32_COLS_XFER)},
-            "acct_claim": 0, "xfer_claim": 0,
-        })
-        state_spec["commit_ts"] = P()
-        state_spec["acct_count"] = P()
-        state_spec["xfer_count"] = P()
-        ev_spec = P()
+        sharded_keys = (
+            "acct_rows", "xfer_rows", "fulfill", "acct_claim", "xfer_claim", "bal_acc"
+        )
+        state_spec = {k: P("shard") for k in sharded_keys}
+        state_spec.update({k: P() for k in ("commit_ts", "acct_count", "xfer_count")})
 
-        self.commit_transfers = jax.jit(
-            shard_map(
-                self._commit_transfers_shard,
-                mesh=mesh,
-                in_specs=(state_spec, ev_spec, P(), P()),
-                out_specs=(state_spec, P(), P()),
-                check_rep=False,
-            ),
-            donate_argnums=(0,),
-        )
-        self.commit_accounts = jax.jit(
-            shard_map(
-                self._commit_accounts_shard,
-                mesh=mesh,
-                in_specs=(state_spec, ev_spec, P(), P()),
-                out_specs=(state_spec, P(), P()),
-                check_rep=False,
-            ),
-            donate_argnums=(0,),
-        )
-        self.lookup_accounts = jax.jit(
-            shard_map(
-                self._lookup_accounts_shard,
-                mesh=mesh,
-                in_specs=(state_spec, ev_spec),
-                out_specs=(P(), P()),
-                check_rep=False,
+        def wrap(fn, n_out_state=True):
+            out_specs = (state_spec, P(), P()) if n_out_state else (P(), P())
+            in_specs = (state_spec, P(), P(), P()) if n_out_state else (state_spec, P())
+            return jax.jit(
+                shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_rep=False),
+                donate_argnums=(0,) if n_out_state else (),
             )
-        )
-        self.lookup_transfers = jax.jit(
-            shard_map(
-                self._lookup_transfers_shard,
-                mesh=mesh,
-                in_specs=(state_spec, ev_spec),
-                out_specs=(P(), P()),
-                check_rep=False,
-            )
-        )
 
-    # -- sharded lookup: local probe + ownership mask + psum --
+        self.commit_transfers = wrap(self._commit_transfers_shard)
+        self.commit_accounts = wrap(self._commit_accounts_shard)
+        self.lookup_accounts = wrap(self._lookup_accounts_shard, n_out_state=False)
+        self.lookup_transfers = wrap(self._lookup_transfers_shard, n_out_state=False)
 
-    def _find(self, tbl, key_lo, key_hi, log2, my_shard):
-        own = owner_u128(key_lo, key_hi, self.n_shards) == my_shard
-        slot, found_l = ht.lookup(key_lo, key_hi, tbl["key_lo"], tbl["key_hi"], log2)
+    # -- sharded lookup: local probe + ownership mask + one row psum --
+
+    def _find(self, rows_local, key4, log2, my_shard):
+        own = owner_of_key4(key4, self.n_shards) == my_shard
+        slot, found_l = ht.lookup(key4, rows_local, log2)
         mine = own & found_l
         found = jax.lax.psum(mine.astype(U32), "shard") > 0
-        row = _psum_row({k: v[slot] for k, v in tbl.items()}, mine, "shard")
+        row = jax.lax.psum(
+            jnp.where(mine[:, None], rows_local[slot], jnp.uint32(0)), "shard"
+        )
         return slot, own, mine, found, row
 
     def _commit_transfers_shard(self, state, ev, n, timestamp):
         my = jax.lax.axis_index("shard")
-        acct = {k: v[0] for k, v in state["acct"].items()}  # local [rows]
-        xfer = {k: v[0] for k, v in state["xfer"].items()}
-        acct_claim = state["acct_claim"][0]
-        xfer_claim = state["xfer_claim"][0]
+        acct_rows = state["acct_rows"][0]
+        xfer_rows = state["xfer_rows"][0]
 
-        B = ev["flags"].shape[0]
+        rows_b = ev["rows"]
+        B = rows_b.shape[0]
+        e = unpack_transfer(rows_b)
         lane = jnp.arange(B, dtype=I32)
         valid = lane < n
         ts_vec = timestamp - n.astype(U64) + lane.astype(U64) + jnp.uint64(1)
-        ev_a = {**ev, "ts": ts_vec}
+        e_a = {**e, "ts": ts_vec}
 
-        dr_slot, dr_own, dr_mine, dr_found, dr = self._find(
-            acct, ev["dr_lo"], ev["dr_hi"], self.a_log2, my
-        )
-        cr_slot, cr_own, cr_mine, cr_found, cr = self._find(
-            acct, ev["cr_lo"], ev["cr_hi"], self.a_log2, my
-        )
-        ex_slot, ex_own, ex_mine, ex_found, ex = self._find(
-            xfer, ev["id_lo"], ev["id_hi"], self.t_log2, my
-        )
+        dr_k4 = key4_from_fields({"id_lo": e["dr_lo"], "id_hi": e["dr_hi"]})
+        cr_k4 = key4_from_fields({"id_lo": e["cr_lo"], "id_hi": e["cr_hi"]})
+        dr_slot, _, dr_mine, dr_found, dr_row = self._find(acct_rows, dr_k4, self.a_log2, my)
+        cr_slot, _, cr_mine, cr_found, cr_row = self._find(acct_rows, cr_k4, self.a_log2, my)
+        _, _, _, ex_found, ex_row = self._find(xfer_rows, rows_b[:, :4], self.t_log2, my)
+        dr = unpack_account(dr_row)
+        cr = unpack_account(cr_row)
+        ex = unpack_transfer(ex_row)
 
-        r0 = jnp.where(ev["ts"] != 0, jnp.uint32(3), jnp.uint32(0))
-        r0 = validate.transfer_common(ev, r0)
+        r0 = jnp.where(e["ts"] != 0, jnp.uint32(3), jnp.uint32(0))
+        r0 = validate.transfer_common(e, r0)
         r, amt_lo, amt_hi = validate.validate_simple_transfer(
-            r0, ev_a, dr, cr, dr_found, cr_found, ex, ex_found
+            r0, e_a, dr, cr, dr_found, cr_found, ex, ex_found
         )
         r = jnp.where(valid, r, jnp.uint32(0))
         ok = valid & (r == 0)
 
         # Hazards (replicated).
-        h_flags = jnp.any(valid & ((ev["flags"] & jnp.uint32(_SLOW_FLAGS)) != 0))
-        h_dup = _has_duplicate_ids(ev["id_lo"], ev["id_hi"], valid)
-        h_amt = jnp.any(ok & (ev["amt_hi"] != 0))
+        h_flags = jnp.any(valid & ((e["flags"] & jnp.uint32(_SLOW_FLAGS)) != 0))
+        h_dup = _has_duplicate_ids(rows_b[:, :4], valid)
         limit_bits = jnp.uint32(validate.A_DR_LIMIT | validate.A_CR_LIMIT)
         h_limit = jnp.any(ok & (((dr["flags"] | cr["flags"]) & limit_bits) != 0))
 
-        # Local balance-delta accumulation: only lanes whose target account
-        # this shard owns (dr/cr row present locally).
-        pending = ok & ((ev["flags"] & jnp.uint32(F_PENDING)) != 0)
-        posted = ok & ~pending
-        mask32 = jnp.uint64(0xFFFFFFFF)
-        d0 = amt_lo & mask32
-        d1 = amt_lo >> jnp.uint64(32)
-        a_rows = (1 << self.a_log2) + 1
-        overflow = jnp.zeros((), dtype=bool)
-        new_bal = {}
-        for colname, cond, slot, mine in (
-            ("dp", pending, dr_slot, dr_mine),
-            ("dpo", posted, dr_slot, dr_mine),
-            ("cp", pending, cr_slot, cr_mine),
-            ("cpo", posted, cr_slot, cr_mine),
-        ):
-            w = jnp.where(cond & mine, slot, self.a_dump)
-            acc0 = jnp.zeros(a_rows, dtype=U64).at[w].add(d0)
-            acc1 = jnp.zeros(a_rows, dtype=U64).at[w].add(d1)
-            lo, hi, over = _apply_digits(
-                acct[colname + "_lo"], acct[colname + "_hi"], acc0, acc1
-            )
-            new_bal[colname + "_lo"] = lo
-            new_bal[colname + "_hi"] = hi
-            overflow = overflow | jnp.any(over[: 1 << self.a_log2])
-        overflow = jax.lax.psum(overflow.astype(U32), "shard") > 0
-        hazard = h_flags | h_dup | h_amt | h_limit | overflow
+        # Local balance-delta accumulation for owned accounts only.
+        digits = _amount_digits(amt_lo, amt_hi)
+        pending = (e["flags"] & jnp.uint32(F_PENDING)) != 0
+        zeros8 = jnp.zeros_like(digits)
+        pend8 = jnp.where(pending[:, None], digits, zeros8)
+        post8 = jnp.where(pending[:, None], zeros8, digits)
+        upd_dr = jnp.concatenate([pend8, post8, zeros8, zeros8], axis=-1)
+        upd_cr = jnp.concatenate([zeros8, zeros8, pend8, post8], axis=-1)
+        slots_t = jnp.concatenate([
+            jnp.where(ok & dr_mine, dr_slot, self.a_dump),
+            jnp.where(ok & cr_mine, cr_slot, self.a_dump),
+        ])
+        upd = jnp.concatenate([upd_dr, upd_cr], axis=0)
+        acc = state["bal_acc"][0].at[slots_t].add(upd)
+        acc_t = acc[slots_t]
+        old_rows_t = acct_rows[slots_t]  # local rows (valid where mine)
+        new_rows_t, over_t = _fold_digits(old_rows_t, acc_t)
+        over_local = jnp.any(over_t & (slots_t != self.a_dump))
+        h_overflow = jax.lax.psum(over_local.astype(U32), "shard") > 0
+        acc = acc.at[slots_t].set(jnp.zeros_like(upd))
+        hazard = h_flags | h_dup | h_limit | h_overflow
 
-        # Apply (no-op when hazard: host re-routes the batch; predicate all
-        # writes so the fast application is safe to discard).
-        apply_ok = ok & ~hazard
-        acct2 = {**acct}
-        for colname in ("dp", "dpo", "cp", "cpo"):
-            for part in ("_lo", "_hi"):
-                acct2[colname + part] = jnp.where(hazard, acct[colname + part],
-                                                  new_bal[colname + part])
-
-        own_id = owner_u128(ev["id_lo"], ev["id_hi"], self.n_shards) == my
-        ins = apply_ok & own_id
-        xfer2 = dict(xfer)
-        slots, k_lo, k_hi, xfer_claim = ht.insert_slots(
-            ev["id_lo"], ev["id_hi"], ins,
-            xfer2["key_lo"], xfer2["key_hi"], xfer_claim, self.t_log2,
+        # Apply (predicated on ~hazard so a hazard batch is a no-op and the
+        # host can re-route it).
+        apply_mask = ok & ~hazard
+        slots_t_m = jnp.where(
+            jnp.concatenate([apply_mask & dr_mine, apply_mask & cr_mine]),
+            jnp.concatenate([dr_slot, cr_slot]),
+            self.a_dump,
         )
-        xfer2["key_lo"], xfer2["key_hi"] = k_lo, k_hi
-        w = jnp.where(ins, slots, self.t_dump)
-        for col, val in (
-            ("dr_lo", ev["dr_lo"]), ("dr_hi", ev["dr_hi"]),
-            ("cr_lo", ev["cr_lo"]), ("cr_hi", ev["cr_hi"]),
-            ("amt_lo", amt_lo), ("amt_hi", amt_hi),
-            ("pid_lo", ev["pid_lo"]), ("pid_hi", ev["pid_hi"]),
-            ("ud128_lo", ev["ud128_lo"]), ("ud128_hi", ev["ud128_hi"]),
-            ("ud64", ev["ud64"]), ("ud32", ev["ud32"]),
-            ("timeout", ev["timeout"]), ("ledger", ev["ledger"]),
-            ("code", ev["code"]), ("flags", ev["flags"]),
-            ("ts", ts_vec), ("fulfill", jnp.zeros_like(ev["ud32"])),
-        ):
-            xfer2[col] = xfer2[col].at[w].set(val)
+        acct2 = acct_rows.at[slots_t_m].set(new_rows_t)
 
-        any_ok = jnp.any(apply_ok)
-        last_ts = jnp.max(jnp.where(apply_ok, ts_vec, jnp.uint64(0)))
+        own_id = owner_of_key4(rows_b[:, :4], self.n_shards) == my
+        ins = apply_mask & own_id
+        ins_rows = _set_ts_words(rows_b, ts_vec)
+        slots, xfer2, claim = ht.insert_rows(
+            ins_rows, ins, xfer_rows, state["xfer_claim"][0], self.t_log2
+        )
+        w = jnp.where(ins, slots, self.t_dump)
+        fulfill = state["fulfill"][0].at[w].set(jnp.uint32(0))
+
+        any_ok = jnp.any(apply_mask)
+        last_ts = jnp.max(jnp.where(apply_mask, ts_vec, jnp.uint64(0)))
         new_state = {
-            "acct": {k: v[None] for k, v in acct2.items()},
-            "xfer": {k: v[None] for k, v in xfer2.items()},
-            "acct_claim": acct_claim[None],
-            "xfer_claim": xfer_claim[None],
+            "acct_rows": acct2[None],
+            "xfer_rows": xfer2[None],
+            "fulfill": fulfill[None],
+            "acct_claim": state["acct_claim"],
+            "xfer_claim": claim[None],
+            "bal_acc": acc[None],
             "commit_ts": jnp.where(any_ok, last_ts, state["commit_ts"]),
             "acct_count": state["acct_count"],
-            "xfer_count": state["xfer_count"] + jnp.sum(apply_ok).astype(U64),
+            "xfer_count": state["xfer_count"] + jnp.sum(apply_mask).astype(U64),
         }
         return new_state, r, hazard
 
     def _commit_accounts_shard(self, state, ev, n, timestamp):
         my = jax.lax.axis_index("shard")
-        acct = {k: v[0] for k, v in state["acct"].items()}
-        acct_claim = state["acct_claim"][0]
+        acct_rows = state["acct_rows"][0]
 
-        B = ev["flags"].shape[0]
+        rows_b = ev["rows"]
+        B = rows_b.shape[0]
+        e = unpack_account(rows_b)
         lane = jnp.arange(B, dtype=I32)
         valid = lane < n
         ts_vec = timestamp - n.astype(U64) + lane.astype(U64) + jnp.uint64(1)
 
-        ex_slot, ex_own, ex_mine, ex_found, ex = self._find(
-            acct, ev["id_lo"], ev["id_hi"], self.a_log2, my
-        )
-        r0 = jnp.where(ev["ts"] != 0, jnp.uint32(3), jnp.uint32(0))
-        r = validate.validate_create_account(r0, ev, ex, ex_found)
+        _, _, _, ex_found, ex_row = self._find(acct_rows, rows_b[:, :4], self.a_log2, my)
+        ex = unpack_account(ex_row)
+        r0 = jnp.where(e["ts"] != 0, jnp.uint32(3), jnp.uint32(0))
+        r = validate.validate_create_account(r0, e, ex, ex_found)
         r = jnp.where(valid, r, jnp.uint32(0))
         ok = valid & (r == 0)
 
-        h_flags = jnp.any(valid & ((ev["flags"] & jnp.uint32(validate.A_LINKED)) != 0))
-        h_dup = _has_duplicate_ids(ev["id_lo"], ev["id_hi"], valid)
+        h_flags = jnp.any(valid & ((e["flags"] & jnp.uint32(validate.A_LINKED)) != 0))
+        h_dup = _has_duplicate_ids(rows_b[:, :4], valid)
         hazard = h_flags | h_dup
 
-        own_id = owner_u128(ev["id_lo"], ev["id_hi"], self.n_shards) == my
+        own_id = owner_of_key4(rows_b[:, :4], self.n_shards) == my
         ins = ok & ~hazard & own_id
-        acct2 = dict(acct)
-        slots, k_lo, k_hi, acct_claim = ht.insert_slots(
-            ev["id_lo"], ev["id_hi"], ins,
-            acct2["key_lo"], acct2["key_hi"], acct_claim, self.a_log2,
+        ins_rows = _set_ts_words(rows_b, ts_vec)
+        slots, acct2, claim = ht.insert_rows(
+            ins_rows, ins, acct_rows, state["acct_claim"][0], self.a_log2
         )
-        acct2["key_lo"], acct2["key_hi"] = k_lo, k_hi
-        w = jnp.where(ins, slots, self.a_dump)
-        for col, val in (
-            ("dp_lo", ev["dp_lo"]), ("dp_hi", ev["dp_hi"]),
-            ("dpo_lo", ev["dpo_lo"]), ("dpo_hi", ev["dpo_hi"]),
-            ("cp_lo", ev["cp_lo"]), ("cp_hi", ev["cp_hi"]),
-            ("cpo_lo", ev["cpo_lo"]), ("cpo_hi", ev["cpo_hi"]),
-            ("ud128_lo", ev["ud128_lo"]), ("ud128_hi", ev["ud128_hi"]),
-            ("ud64", ev["ud64"]), ("ud32", ev["ud32"]),
-            ("ledger", ev["ledger"]), ("code", ev["code"]),
-            ("flags", ev["flags"]), ("ts", ts_vec),
-        ):
-            acct2[col] = acct2[col].at[w].set(val)
 
-        apply_ok = ok & ~hazard
-        any_ok = jnp.any(apply_ok)
-        last_ts = jnp.max(jnp.where(apply_ok, ts_vec, jnp.uint64(0)))
+        apply_mask = ok & ~hazard
+        any_ok = jnp.any(apply_mask)
+        last_ts = jnp.max(jnp.where(apply_mask, ts_vec, jnp.uint64(0)))
         new_state = {
-            "acct": {k: v[None] for k, v in acct2.items()},
-            "xfer": state["xfer"],
-            "acct_claim": acct_claim[None],
+            "acct_rows": acct2[None],
+            "xfer_rows": state["xfer_rows"],
+            "fulfill": state["fulfill"],
+            "acct_claim": claim[None],
             "xfer_claim": state["xfer_claim"],
+            "bal_acc": state["bal_acc"],
             "commit_ts": jnp.where(any_ok, last_ts, state["commit_ts"]),
-            "acct_count": state["acct_count"] + jnp.sum(apply_ok).astype(U64),
+            "acct_count": state["acct_count"] + jnp.sum(apply_mask).astype(U64),
             "xfer_count": state["xfer_count"],
         }
         return new_state, r, hazard
 
     def _lookup_accounts_shard(self, state, ids):
         my = jax.lax.axis_index("shard")
-        acct = {k: v[0] for k, v in state["acct"].items()}
-        _, _, _, found, row = self._find(acct, ids["id_lo"], ids["id_hi"], self.a_log2, my)
+        _, _, _, found, row = self._find(state["acct_rows"][0], ids["key4"], self.a_log2, my)
         return found, row
 
     def _lookup_transfers_shard(self, state, ids):
         my = jax.lax.axis_index("shard")
-        xfer = {k: v[0] for k, v in state["xfer"].items()}
-        _, _, _, found, row = self._find(xfer, ids["id_lo"], ids["id_hi"], self.t_log2, my)
+        _, _, _, found, row = self._find(state["xfer_rows"][0], ids["key4"], self.t_log2, my)
         return found, row
 
 
