@@ -157,6 +157,38 @@ def test_commit_ts_survives_full_chain_rollback():
     assert dev.commit_timestamp == oracle.commit_timestamp
 
 
+def test_fast_tier_combined_overflow_hazard():
+    """A hazard-free-looking batch mixing pending and posted amounts to one
+    account must still hit codes 51/52 (combined dp+dpo overflow, reference:
+    src/state_machine.zig:856-861) — the hazard predicate must route it to
+    the serial tier rather than silently committing in auto mode."""
+    from tigerbeetle_tpu.types import Account, TransferFlags
+
+    oracle = OracleStateMachine()
+    dev = DeviceLedger(process=TEST_PROCESS, mode="auto")
+    ts = 10_000
+    accounts = [Account(id=i, ledger=1, code=1) for i in (1, 2)]
+    ts += 2
+    oracle.execute_dense(Operation.create_accounts, ts, accounts)
+    dev.execute_dense(Operation.create_accounts, ts, accounts)
+
+    big = 1 << 127
+    transfers = [
+        Transfer(id=40, debit_account_id=1, credit_account_id=2, amount=big,
+                 ledger=1, code=1, flags=int(TransferFlags.pending)),
+        Transfer(id=41, debit_account_id=1, credit_account_id=2, amount=big,
+                 ledger=1, code=1),
+    ]
+    ts += 2
+    dense_o = oracle.execute_dense(Operation.create_transfers, ts, transfers)
+    dense_d = dev.execute_dense(Operation.create_transfers, ts, transfers)
+    assert dense_o == [0, 51]  # overflows_debits
+    assert dense_d == dense_o
+    accounts_d, transfers_d, _ = dev.extract()
+    assert accounts_d == oracle.accounts
+    assert transfers_d == oracle.transfers
+
+
 def test_capacity_guard():
     import pytest as _pytest
 

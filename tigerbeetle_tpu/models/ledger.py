@@ -253,6 +253,20 @@ def _fold_digits(row32, acc32):
     return jnp.stack(new_words, axis=-1), overflow
 
 
+def _combined_overflow(new_rows_t):
+    """Per-lane carry of the COMBINED debits_pending+debits_posted and
+    credits_pending+credits_posted sums of folded account rows. Codes 51/52
+    guard these sums (reference: src/state_machine.zig:856-861), not just each
+    field: a batch mixing pending and posted amounts to one account can
+    overflow dp+dpo with neither field's fold carrying. All fast-tier deltas
+    are non-negative, so the batch-final combined sums overflow iff some
+    prefix does — checking the folded rows is exact."""
+    nr = unpack_account(new_rows_t)
+    _, _, c_dr = u128.add(nr["dp_lo"], nr["dp_hi"], nr["dpo_lo"], nr["dpo_hi"])
+    _, _, c_cr = u128.add(nr["cp_lo"], nr["cp_hi"], nr["cpo_lo"], nr["cpo_hi"])
+    return c_dr | c_cr
+
+
 def _set_ts_words(rows, ts):
     t0, t1 = _lohi(ts)
     return jnp.concatenate(
@@ -352,7 +366,9 @@ class LedgerKernels:
         acc_t = acc[slots_t]  # [2B, 32]
         old_rows_t = jnp.concatenate([dr_row, cr_row], axis=0)
         new_rows_t, over_t = _fold_digits(old_rows_t, acc_t)
-        h_overflow = jnp.any(over_t & (slots_t != self.a_dump))
+        h_overflow = jnp.any(
+            (over_t | _combined_overflow(new_rows_t)) & (slots_t != self.a_dump)
+        )
         acc = acc.at[slots_t].set(jnp.zeros_like(upd))  # restore all-zero
         hazard = h_flags | h_dup | h_limit | h_overflow
 

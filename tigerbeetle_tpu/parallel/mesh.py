@@ -40,6 +40,7 @@ from tigerbeetle_tpu.models.ledger import (
     ROW_WORDS,
     _SLOW_FLAGS,
     _amount_digits,
+    _combined_overflow,
     _fold_digits,
     _has_duplicate_ids,
     _next_pow2,
@@ -195,7 +196,9 @@ class ShardedLedgerKernels:
         acc_t = acc[slots_t]
         old_rows_t = acct_rows[slots_t]  # local rows (valid where mine)
         new_rows_t, over_t = _fold_digits(old_rows_t, acc_t)
-        over_local = jnp.any(over_t & (slots_t != self.a_dump))
+        over_local = jnp.any(
+            (over_t | _combined_overflow(new_rows_t)) & (slots_t != self.a_dump)
+        )
         h_overflow = jax.lax.psum(over_local.astype(U32), "shard") > 0
         acc = acc.at[slots_t].set(jnp.zeros_like(upd))
         hazard = h_flags | h_dup | h_limit | h_overflow
