@@ -1,0 +1,184 @@
+"""Bit-exact parity: the SHARDED ledger vs. the oracle on the 8-device mesh.
+
+The sharded analog of tests/test_ledger_parity.py (reference model:
+src/state_machine.zig semantics; sharding itself has no reference analog —
+SURVEY.md §2.6). Exercises both tiers: the vectorized fast tier on clean
+batches and the sharded serial tier (per-step psum lookups, ownership-masked
+writes, chain rollback) on hazard batches.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tigerbeetle_tpu.constants import ConfigProcess
+from tigerbeetle_tpu.models.oracle import OracleStateMachine
+from tigerbeetle_tpu.parallel.mesh import ShardedLedger
+from tigerbeetle_tpu.testing.workload import WorkloadGenerator
+from tigerbeetle_tpu.types import Account, Operation, Transfer, TransferFlags
+
+PROCESS = ConfigProcess(account_slots_log2=10, transfer_slots_log2=12)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()[:8]
+    assert len(devices) == 8, "conftest must provide 8 virtual CPU devices"
+    return Mesh(np.array(devices), ("shard",))
+
+
+def run_parity(mesh, seed, n_batches, batch_size, state_every=4, **wl_kwargs):
+    oracle = OracleStateMachine()
+    dev = ShardedLedger(mesh, PROCESS)
+    gen = WorkloadGenerator(seed, **wl_kwargs)
+    ts = 1_000_000_000
+    for b in range(n_batches):
+        if b % 4 == 0:
+            op, events = gen.gen_accounts_batch(batch_size)
+        else:
+            op, events = gen.gen_transfers_batch(batch_size)
+        ts += len(events)
+        dense_o = oracle.execute_dense(op, ts, events)
+        dense_d = dev.execute_dense(op, ts, events)
+        if dense_d != dense_o:
+            diffs = [
+                (i, o, d) for i, (o, d) in enumerate(zip(dense_o, dense_d)) if o != d
+            ]
+            raise AssertionError(f"batch {b} ({op.name}): (idx, oracle, dev) {diffs[:10]}")
+        if b % state_every == state_every - 1:
+            accounts, transfers, posted = dev.extract()
+            assert accounts == oracle.accounts, f"batch {b}: account state diverged"
+            assert transfers == oracle.transfers, f"batch {b}: transfer state diverged"
+            assert posted == oracle.posted, f"batch {b}: posted state diverged"
+            assert dev.commit_timestamp == oracle.commit_timestamp
+    return oracle, dev
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_sharded_parity_hazard_workload(mesh, seed):
+    """Randomized workload with chains/two-phase/balancing/limits — routes
+    through the sharded SERIAL tier."""
+    run_parity(mesh, seed, n_batches=8, batch_size=32)
+
+
+def test_sharded_parity_clean_workload(mesh):
+    """Hazard-free workload — stays on the vectorized fast tier."""
+    run_parity(
+        mesh, 13, n_batches=8, batch_size=32,
+        chain_rate=0.0, two_phase_rate=0.0, balancing_rate=0.0,
+        limit_account_rate=0.0, conflict_rate=0.0,
+    )
+
+
+def test_sharded_lookup_parity(mesh):
+    oracle, dev = run_parity(mesh, 14, n_batches=6, batch_size=24, state_every=100)
+    gen = WorkloadGenerator(99)
+    gen.account_ids = list(oracle.accounts.keys())[:40]
+    gen.transfer_ids = list(oracle.transfers.keys())[:40]
+    _, ids_a = gen.gen_lookup_batch(32, "accounts")
+    _, ids_t = gen.gen_lookup_batch(32, "transfers")
+    assert dev.lookup_accounts(ids_a) == oracle.lookup_accounts(ids_a)
+    assert dev.lookup_transfers(ids_t) == oracle.lookup_transfers(ids_t)
+
+
+def test_sharded_linked_chain_rollback(mesh):
+    """Directed: a mid-batch chain break must roll back every shard's writes
+    (cross-shard undo via per-shard slot logs)."""
+    oracle = OracleStateMachine()
+    dev = ShardedLedger(mesh, PROCESS)
+    ts = 10_000
+    accounts = [Account(id=i, ledger=1, code=1) for i in (1, 2, 3)]
+    ts += 3
+    assert oracle.execute_dense(Operation.create_accounts, ts, accounts) == \
+        dev.execute_dense(Operation.create_accounts, ts, accounts)
+
+    transfers = [
+        Transfer(id=10, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=1, flags=1),
+        Transfer(id=11, debit_account_id=2, credit_account_id=3, amount=7,
+                 ledger=1, code=1, flags=1),
+        Transfer(id=12, debit_account_id=1, credit_account_id=3, amount=0,
+                 ledger=1, code=1),
+        Transfer(id=13, debit_account_id=1, credit_account_id=2, amount=9,
+                 ledger=1, code=1),
+    ]
+    ts += 4
+    dense_o = oracle.execute_dense(Operation.create_transfers, ts, transfers)
+    dense_d = dev.execute_dense(Operation.create_transfers, ts, transfers)
+    assert dense_o == [1, 1, 18, 0]
+    assert dense_d == dense_o
+    accounts_d, transfers_d, _ = dev.extract()
+    assert accounts_d == oracle.accounts
+    assert transfers_d == oracle.transfers
+    assert 13 in transfers_d and 10 not in transfers_d
+
+
+def test_sharded_two_phase(mesh):
+    """Directed: pending + post + void across shards (fulfill column lives on
+    the pending transfer's owner shard)."""
+    oracle = OracleStateMachine()
+    dev = ShardedLedger(mesh, PROCESS)
+    ts = 10_000
+    accounts = [Account(id=i, ledger=1, code=1) for i in (1, 2)]
+    ts += 2
+    oracle.execute_dense(Operation.create_accounts, ts, accounts)
+    dev.execute_dense(Operation.create_accounts, ts, accounts)
+
+    transfers = [
+        Transfer(id=20, debit_account_id=1, credit_account_id=2, amount=100,
+                 ledger=1, code=1, flags=int(TransferFlags.pending)),
+        Transfer(id=21, pending_id=20, amount=60, ledger=0, code=0,
+                 flags=int(TransferFlags.post_pending_transfer)),
+        Transfer(id=22, pending_id=20, ledger=0, code=0,
+                 flags=int(TransferFlags.void_pending_transfer)),
+    ]
+    ts += 3
+    dense_o = oracle.execute_dense(Operation.create_transfers, ts, transfers)
+    dense_d = dev.execute_dense(Operation.create_transfers, ts, transfers)
+    assert dense_o == [0, 0, 33]  # pending_transfer_already_posted
+    assert dense_d == dense_o
+    accounts_d, transfers_d, posted_d = dev.extract()
+    assert accounts_d == oracle.accounts
+    assert transfers_d == oracle.transfers
+    assert posted_d == oracle.posted
+
+
+def test_sharded_combined_overflow(mesh):
+    """The combined dp+dpo overflow (codes 51/52) must be exact on the
+    sharded ledger too: the host's amount-sum bound routes the batch to the
+    sharded serial tier, which computes code 51."""
+    oracle = OracleStateMachine()
+    dev = ShardedLedger(mesh, PROCESS)
+    ts = 10_000
+    accounts = [Account(id=i, ledger=1, code=1) for i in (1, 2)]
+    ts += 2
+    oracle.execute_dense(Operation.create_accounts, ts, accounts)
+    dev.execute_dense(Operation.create_accounts, ts, accounts)
+
+    big = 1 << 127
+    transfers = [
+        Transfer(id=40, debit_account_id=1, credit_account_id=2, amount=big,
+                 ledger=1, code=1, flags=int(TransferFlags.pending)),
+        Transfer(id=41, debit_account_id=1, credit_account_id=2, amount=big,
+                 ledger=1, code=1),
+    ]
+    ts += 2
+    dense_o = oracle.execute_dense(Operation.create_transfers, ts, transfers)
+    dense_d = dev.execute_dense(Operation.create_transfers, ts, transfers)
+    assert dense_o == [0, 51]  # overflows_debits
+    assert dense_d == dense_o
+    accounts_d, transfers_d, _ = dev.extract()
+    assert accounts_d == oracle.accounts
+    assert transfers_d == oracle.transfers
+
+
+def test_sharded_load_guard(mesh):
+    """The per-shard occupancy guard fails loudly before any shard's local
+    table can exceed its load-factor cap (owner-hash skew means one shard
+    fills first)."""
+    small = ConfigProcess(account_slots_log2=4, transfer_slots_log2=6)
+    dev = ShardedLedger(Mesh(np.array(jax.devices()[:2]), ("shard",)), small)
+    accounts = [Account(id=i, ledger=1, code=1) for i in range(1, 40)]
+    with pytest.raises(RuntimeError, match="load-factor"):
+        dev.execute_dense(Operation.create_accounts, 100, accounts)

@@ -102,19 +102,20 @@ def test_hashtable_insert_then_lookup():
     k4 = _key4_batch(keys)
     ins = _rows_from_key4(k4)
     active = jnp.ones(len(keys), dtype=bool)
-    slots, rows, claim = ht.insert_rows(ins, active, rows, claim, log2)
+    slots, rows, claim, resolved = ht.insert_rows(ins, active, rows, claim, log2)
+    assert bool(jnp.all(resolved))
     slots = np.asarray(slots)
     # All inserted at distinct, in-range slots; claim scratch fully reset.
     assert len(set(slots.tolist())) == len(keys)
     assert slots.max() < (1 << log2)
     assert bool(jnp.all(claim == ht.CLAIM_FREE))
     # Every key found at its claimed slot.
-    got_slots, found = ht.lookup(k4, rows, log2)
-    assert bool(jnp.all(found))
+    got_slots, found, res = ht.lookup(k4, rows, log2)
+    assert bool(jnp.all(found)) and bool(jnp.all(res))
     assert np.array_equal(np.asarray(got_slots), slots)
     # Absent keys (hi limb flipped) not found.
     absent = k4.at[:, 3].set(k4[:, 3] ^ jnp.uint32(0xDEADBEEF))
-    _, found2 = ht.lookup(absent, rows, log2)
+    _, found2, _ = ht.lookup(absent, rows, log2)
     assert not bool(jnp.any(found2))
 
 
@@ -124,8 +125,8 @@ def test_hashtable_insert_inactive_lanes_untouched():
     claim = jnp.full((1 << log2) + 1, ht.CLAIM_FREE, dtype=jnp.uint32)
     k4 = _key4_batch([10, 11, 12, 13])
     active = jnp.asarray([True, False, True, False])
-    slots, rows, claim = ht.insert_rows(_rows_from_key4(k4), active, rows, claim, log2)
-    _, found = ht.lookup(k4, rows, log2)
+    slots, rows, claim, _ = ht.insert_rows(_rows_from_key4(k4), active, rows, claim, log2)
+    _, found, _ = ht.lookup(k4, rows, log2)
     assert np.asarray(found).tolist() == [True, False, True, False]
     assert int(np.asarray(slots)[1]) == 1 << log2  # dump slot for inactive
 
@@ -134,15 +135,16 @@ def test_hashtable_scalar_probe_and_tombstone():
     log2 = 4
     rows = _mk_table(log2)
     k4 = _key4(42)
-    slot = ht.probe_free_scalar(k4, rows, log2)
+    slot, free_ok = ht.probe_free(k4, rows, log2)
+    assert bool(free_ok)
     rows = rows.at[slot, :4].set(k4)
-    s2, found = ht.lookup(k4, rows, log2)
+    s2, found, _ = ht.lookup(k4, rows, log2)
     assert bool(found) and int(s2) == int(slot)
     # Tombstone the slot: lookup misses, probe_free reuses it.
     rows = rows.at[slot].set(jnp.full(32, 0xFFFFFFFF, dtype=jnp.uint32))
-    _, found3 = ht.lookup(k4, rows, log2)
+    _, found3, _ = ht.lookup(k4, rows, log2)
     assert not bool(found3)
-    s4 = ht.probe_free_scalar(k4, rows, log2)
+    s4, _ = ht.probe_free(k4, rows, log2)
     assert int(s4) == int(slot)
 
 
@@ -153,8 +155,8 @@ def test_hashtable_lookup_skips_tombstone_in_chain():
     rows = _mk_table(log2)
     k4 = _key4(777)
     h = int(ht.hash_key4(k4, log2))
-    nxt = (h + 1) & ((1 << log2) - 1)
+    nxt = (h + int(ht.probe_step(k4, log2))) & ((1 << log2) - 1)
     rows = rows.at[h].set(jnp.full(32, 0xFFFFFFFF, dtype=jnp.uint32))
     rows = rows.at[nxt, :4].set(k4)
-    s, found = ht.lookup(k4, rows, log2)
+    s, found, _ = ht.lookup(k4, rows, log2)
     assert bool(found) and int(s) == nxt

@@ -4,23 +4,27 @@ This is the TPU-native redesign of the reference's hot path (reference:
 src/state_machine.zig:508-698 commit/execute): the account and transfer
 stores are HBM-resident open-addressing hash tables whose rows ARE the
 128-byte wire format (one [capacity+1, 32] u32 array per table — see
-ops/hashtable.py for why u32 rows are the fast layout on TPU), and a whole
-prepare batch commits in one jitted step. Host batches upload as a single
-bitcast of the wire bytes.
+ops/hashtable.py for the probe design and why u32 rows are the fast layout
+on TPU), and a whole prepare batch commits in one jitted step. Host batches
+upload as a single bitcast of the wire bytes.
 
-Two execution tiers live inside the same compiled function, dispatched by a
-device-computed hazard predicate via lax.cond:
+Two execution tiers, selected ON THE HOST before dispatch (the device
+kernels are straight-line programs — no lax.cond dispatch, no while_loops;
+see ops/hashtable.py for why data-dependent control flow is banned):
 
 - **Fast tier (vectorized)**: all lookups, validation, and application run
   data-parallel over the batch. Sound only when the batch is free of serial
   hazards — no linked chains, no post/void or balancing events, no duplicate
   ids, no touched account with balance-limit flags, and no u128 overflow even
   at the batch-final balances (all fast-tier balance deltas are non-negative,
-  so per-prefix overflow is impossible iff final overflow is). Balance deltas
-  accumulate as 16-bit digits in a persistent [capacity+1, 32] u32 scratch
-  (4 balance fields x 8 digits; digit sums of <= 2^13 events stay < 2^30), and
-  a touched-slot digit-carry pass folds them into the u128 balances — all in
-  u32, no big-array traffic.
+  so per-prefix overflow is impossible iff final overflow is). The HOST
+  proves every one of these conditions before choosing this tier — see
+  DeviceLedger._transfers_hazard (flags/dups from the batch itself, a
+  limit-account id set, and an exact running amount-sum bound for overflow).
+  Balance deltas accumulate as 16-bit digits in a persistent
+  [capacity+1, 32] u32 scratch (4 balance fields x 8 digits; digit sums of
+  <= 2^13 events stay < 2^30), and a touched-slot digit-carry pass folds
+  them into the u128 balances — all in u32, no big-array traffic.
 - **Serial tier (lax.scan)**: an exact, event-at-a-time kernel with the full
   semantics — linked-chain rollback via an undo log (reference:
   src/state_machine.zig:612-698 + src/lsm/groove.zig:990-1010 scopes),
@@ -29,6 +33,20 @@ device-computed hazard predicate via lax.cond:
 
 Both tiers call the same validation ladders (models/validate.py), so result
 codes are bit-exact against the oracle (models/oracle.py) on every path.
+
+**Fault protocol**: probe windows are finite (ops/hashtable.py), so a probe
+chain or claim contention can — with ~2^-32 probability per op at the
+enforced <= 1/2 load factor — exceed the window. The fast kernel detects
+every such case BEFORE writing anything, turns the whole commit into a
+no-op, and sets a sticky `fault` word in the state; once fault != 0, every
+subsequent commit is also a no-op, so the device state stays exactly as of
+the last good batch. The host checks the fault word (per batch on the sync
+path, amortized on the async path) and raises. The serial kernel applies
+as it scans and cannot un-apply, so its unresolved probes mark the fault
+word as corrupting (FAULT_SERIAL) — with the 64-probe scalar window this is
+a ~2^-64 event. The reference's analog is its assert-dense ReleaseSafe
+discipline (reference: src/tigerbeetle.zig:263-266): fail loudly, never
+corrupt silently.
 
 The reference's `posted` groove (reference: src/state_machine.zig:185-198) is
 the `fulfill` column alongside the transfer rows (1:1 by construction).
@@ -62,6 +80,35 @@ I32 = jnp.int32
 _SLOW_FLAGS = 0b111101
 
 ROW_WORDS = 32  # 128-byte wire rows as u32 words
+
+# Sticky fault bits (see module docstring "Fault protocol").
+FAULT_PROBE = 1  # fast-tier lookup window exhausted (batch was a no-op)
+FAULT_CLAIM = 2  # fast-tier claim rounds exhausted (batch was a no-op)
+FAULT_OVERFLOW = 4  # device-side overflow backstop tripped (batch was a no-op)
+FAULT_SERIAL = 8  # serial-tier probe window exhausted — STATE IS CORRUPT
+
+_FAULT_NAMES = (
+    (FAULT_PROBE, "probe-window"),
+    (FAULT_CLAIM, "claim-rounds"),
+    (FAULT_OVERFLOW, "overflow-backstop"),
+    (FAULT_SERIAL, "serial-probe"),
+)
+
+
+def raise_on_fault(fault: int, what: str) -> None:
+    """Shared fault-word decoder (single-chip and sharded ledgers)."""
+    if not fault:
+        return
+    bits = [name for bit, name in _FAULT_NAMES if fault & bit]
+    corrupt = (
+        " (serial tier: device state is CORRUPT)"
+        if fault & FAULT_SERIAL
+        else " (the faulting batch and everything after were no-ops)"
+    )
+    raise RuntimeError(
+        f"{what} fault {fault:#x} [{', '.join(bits)}]{corrupt}: "
+        "grow the table (slots_log2) or lower the load factor"
+    )
 
 
 # ----------------------------------------------------------------------
@@ -159,7 +206,8 @@ def key4_from_fields(f):
 def init_state(process: ConfigProcess = DEFAULT_PROCESS) -> dict:
     """Allocate the device ledger. Tables have capacity+1 rows: the last row
     is the write dump for masked scatters (never read). `bal_acc` is the
-    persistent balance-digit accumulator (all-zero between commits)."""
+    persistent balance-digit accumulator (all-zero between commits). `fault`
+    is the sticky fault word (0 = healthy; see module docstring)."""
     a_rows = (1 << process.account_slots_log2) + 1
     t_rows = (1 << process.transfer_slots_log2) + 1
     return {
@@ -172,6 +220,7 @@ def init_state(process: ConfigProcess = DEFAULT_PROCESS) -> dict:
         "commit_ts": jnp.uint64(0),
         "acct_count": jnp.uint64(0),
         "xfer_count": jnp.uint64(0),
+        "fault": jnp.uint32(0),
     }
 
 
@@ -206,20 +255,6 @@ def ids_to_batch(ids: list[int], n_pad: int) -> dict:
 # ----------------------------------------------------------------------
 # helpers
 # ----------------------------------------------------------------------
-
-
-def _has_duplicate_ids(key4, valid):
-    """True iff two valid lanes share an id (exact; sorts the four u32 id
-    words — u32 sort keys are far cheaper than emulated-u64 ones on TPU).
-    Invalid lanes sort last via a leading key and are excluded."""
-    inv = (~valid).astype(U32)
-    s = jax.lax.sort(
-        (inv, key4[:, 3], key4[:, 2], key4[:, 1], key4[:, 0]), num_keys=5
-    )
-    dup = (s[0][1:] == 0) & (s[0][:-1] == 0)
-    for a in s[1:]:
-        dup = dup & (a[1:] == a[:-1])
-    return jnp.any(dup)
 
 
 def _amount_digits(amt_lo, amt_hi):
@@ -291,8 +326,10 @@ class LedgerKernels:
         self.process = process
         self.a_log2 = process.account_slots_log2
         self.t_log2 = process.transfer_slots_log2
-        self.a_dump = jnp.int32(1 << self.a_log2)
-        self.t_dump = jnp.int32(1 << self.t_log2)
+        # Python ints (embedded as literals) — capturing jnp scalars in the
+        # kernels would poison dispatch (see ops/hashtable.py note).
+        self.a_dump = 1 << self.a_log2
+        self.t_dump = 1 << self.t_log2
         self.commit_transfers = jax.jit(
             self._commit_transfers, static_argnames=("mode",), donate_argnums=(0,)
         )
@@ -306,10 +343,13 @@ class LedgerKernels:
     # create_transfers
     # ------------------------------------------------------------------
 
-    def _commit_transfers(self, state, ev, n, timestamp, mode: str = "auto"):
-        """Returns (state', results u32 [B])."""
+    def _commit_transfers(self, state, ev, n, timestamp, mode: str = "fast"):
+        """Returns (state', results u32 [B]). `mode` is chosen by the HOST
+        ("fast" only for host-proven hazard-free batches — see
+        DeviceLedger._transfers_hazard)."""
         if mode == "serial":
             return self._serial_transfers(state, ev, n, timestamp)
+        assert mode == "fast", mode
 
         rows_b = ev["rows"]
         B = rows_b.shape[0]
@@ -323,12 +363,12 @@ class LedgerKernels:
         xfer_rows = state["xfer_rows"]
         # dr and cr probe the same table: fuse into one 2B-lane lookup.
         both_k4 = jnp.concatenate([rows_b[:, 4:8], rows_b[:, 8:12]], axis=0)
-        both_slot, both_found = ht.lookup(both_k4, acct_rows, self.a_log2)
+        both_slot, both_found, both_res = ht.lookup(both_k4, acct_rows, self.a_log2)
         both_rows = acct_rows[both_slot]
         dr_slot, cr_slot = both_slot[:B], both_slot[B:]
         dr_found, cr_found = both_found[:B], both_found[B:]
         dr_row, cr_row = both_rows[:B], both_rows[B:]
-        ex_slot, ex_found = ht.lookup(rows_b[:, :4], xfer_rows, self.t_log2)
+        ex_slot, ex_found, ex_res = ht.lookup(rows_b[:, :4], xfer_rows, self.t_log2)
         dr = unpack_account(dr_row)
         cr = unpack_account(cr_row)
         ex = unpack_transfer(xfer_rows[ex_slot])
@@ -341,11 +381,17 @@ class LedgerKernels:
         r = jnp.where(valid, r, jnp.uint32(0))
         ok = valid & (r == 0)
 
-        # Hazard predicate — any condition the vectorized tier cannot honor.
-        h_flags = jnp.any(valid & ((e["flags"] & jnp.uint32(_SLOW_FLAGS)) != 0))
-        h_dup = _has_duplicate_ids(rows_b[:, :4], valid)
-        limit_bits = jnp.uint32(validate.A_DR_LIMIT | validate.A_CR_LIMIT)
-        h_limit = jnp.any(ok & (((dr["flags"] | cr["flags"]) & limit_bits) != 0))
+        # Unresolved probes among lanes that matter -> abort the whole batch
+        # (fault protocol; writes below are gated on `proceed`).
+        valid2 = jnp.concatenate([valid, valid])
+        probe_bad = jnp.any(valid2 & ~both_res) | jnp.any(valid & ~ex_res)
+
+        # Claim insert slots (pure claim phase; rows written below, after
+        # gating). Keys are batch-unique and absent — host-proven.
+        ins_slots, claim, ins_res = ht.claim_slots(
+            rows_b[:, :4], ok, xfer_rows, state["xfer_claim"], self.t_log2
+        )
+        claim_bad = jnp.any(~ins_res)
 
         # Balance deltas: 16-bit digit scatter-add into the persistent
         # accumulator, then a touched-slot carry fold. acc lane layout:
@@ -366,42 +412,41 @@ class LedgerKernels:
         acc_t = acc[slots_t]  # [2B, 32]
         old_rows_t = jnp.concatenate([dr_row, cr_row], axis=0)
         new_rows_t, over_t = _fold_digits(old_rows_t, acc_t)
-        h_overflow = jnp.any(
+        # Device-side backstop for the host's overflow bound (codes 51/52
+        # combined-sum carries included — see _combined_overflow).
+        over_bad = jnp.any(
             (over_t | _combined_overflow(new_rows_t)) & (slots_t != self.a_dump)
         )
         acc = acc.at[slots_t].set(jnp.zeros_like(upd))  # restore all-zero
-        hazard = h_flags | h_dup | h_limit | h_overflow
 
+        fault = (
+            state["fault"]
+            | jnp.where(probe_bad, jnp.uint32(FAULT_PROBE), jnp.uint32(0))
+            | jnp.where(claim_bad, jnp.uint32(FAULT_CLAIM), jnp.uint32(0))
+            | jnp.where(over_bad, jnp.uint32(FAULT_OVERFLOW), jnp.uint32(0))
+        )
+        proceed = fault == 0  # sticky: also no-ops every batch after a fault
+
+        # --- application (every write gated on `proceed`) ---
         ins_rows = _set_ts_words(rows_b, ts_vec)
-
-        def fast_branch(state):
-            acct2 = state["acct_rows"].at[slots_t].set(new_rows_t)
-            slots, xfer2, claim = ht.insert_rows(
-                ins_rows, ok, state["xfer_rows"], state["xfer_claim"], self.t_log2
-            )
-            w = jnp.where(ok, slots, self.t_dump)
-            fulfill = state["fulfill"].at[w].set(jnp.uint32(0))
-            any_ok = jnp.any(ok)
-            last_ts = jnp.max(jnp.where(ok, ts_vec, jnp.uint64(0)))
-            return {
-                **state,
-                "acct_rows": acct2,
-                "xfer_rows": xfer2,
-                "fulfill": fulfill,
-                "xfer_claim": claim,
-                "bal_acc": acc,
-                "commit_ts": jnp.where(any_ok, last_ts, state["commit_ts"]),
-                "xfer_count": state["xfer_count"] + jnp.sum(ok).astype(U64),
-            }, r
-
-        if mode == "fast":
-            return fast_branch(state)
-
-        def serial_branch(state):
-            state2, results = self._serial_transfers(state, ev, n, timestamp)
-            return {**state2, "bal_acc": acc}, results
-
-        return jax.lax.cond(hazard, serial_branch, fast_branch, state)
+        acct2 = acct_rows.at[jnp.where(proceed, slots_t, self.a_dump)].set(new_rows_t)
+        w = jnp.where(proceed & ok, ins_slots, self.t_dump)
+        xfer2 = xfer_rows.at[w].set(ins_rows)
+        fulfill = state["fulfill"].at[w].set(jnp.uint32(0))
+        applied = proceed & jnp.any(ok)
+        last_ts = jnp.max(jnp.where(ok, ts_vec, jnp.uint64(0)))
+        return {
+            **state,
+            "acct_rows": acct2,
+            "xfer_rows": xfer2,
+            "fulfill": fulfill,
+            "xfer_claim": claim,
+            "bal_acc": acc,
+            "commit_ts": jnp.where(applied, last_ts, state["commit_ts"]),
+            "xfer_count": state["xfer_count"]
+            + jnp.where(proceed, jnp.sum(ok).astype(U64), jnp.uint64(0)),
+            "fault": fault,
+        }, r
 
     # -- exact serial tier --
 
@@ -410,7 +455,9 @@ class LedgerKernels:
         B = rows_b.shape[0]
         lanes = jnp.arange(B, dtype=I32)
         a_dump, t_dump = self.a_dump, self.t_dump
-        tomb_row = jnp.asarray(_TOMB_ROW)
+        tomb_row = _TOMB_ROW  # numpy: embeds as a literal
+        # Sticky-fault entry gate: a faulted ledger commits nothing.
+        n = jnp.where(state["fault"] == 0, n, jnp.int32(0))
 
         undo0 = {
             "kind": jnp.zeros(B, dtype=U32),
@@ -430,10 +477,12 @@ class LedgerKernels:
             jnp.int32(-1),  # chain_start
             jnp.zeros((), dtype=bool),  # chain_broken
             state["commit_ts"],
+            jnp.zeros((), dtype=bool),  # unresolved-probe accumulator
         )
 
         def step(carry, x):
-            acct_rows, xfer_rows, fulfill, results, undo, chain_start, chain_broken, commit_ts = carry
+            (acct_rows, xfer_rows, fulfill, results, undo, chain_start,
+             chain_broken, commit_ts, probe_bad) = carry
             i, row_e = x
             e = unpack_transfer(row_e)
             active = i < n
@@ -454,15 +503,21 @@ class LedgerKernels:
             r0 = validate.transfer_common(e, lad.r)
 
             k4 = key4_from_fields
-            dr_slot, dr_found = ht.lookup(
-                k4({"id_lo": e["dr_lo"], "id_hi": e["dr_hi"]}), acct_rows, self.a_log2
+            W = ht.WINDOW_SCALAR
+            dr_slot, dr_found, res1 = ht.lookup(
+                k4({"id_lo": e["dr_lo"], "id_hi": e["dr_hi"]}), acct_rows,
+                self.a_log2, window=W,
             )
-            cr_slot, cr_found = ht.lookup(
-                k4({"id_lo": e["cr_lo"], "id_hi": e["cr_hi"]}), acct_rows, self.a_log2
+            cr_slot, cr_found, res2 = ht.lookup(
+                k4({"id_lo": e["cr_lo"], "id_hi": e["cr_hi"]}), acct_rows,
+                self.a_log2, window=W,
             )
-            ex_slot, ex_found = ht.lookup(row_e[:4], xfer_rows, self.t_log2)
-            p_slot, p_found = ht.lookup(
-                k4({"id_lo": e["pid_lo"], "id_hi": e["pid_hi"]}), xfer_rows, self.t_log2
+            ex_slot, ex_found, res3 = ht.lookup(
+                row_e[:4], xfer_rows, self.t_log2, window=W
+            )
+            p_slot, p_found, res4 = ht.lookup(
+                k4({"id_lo": e["pid_lo"], "id_hi": e["pid_hi"]}), xfer_rows,
+                self.t_log2, window=W,
             )
             dr = unpack_account(acct_rows[dr_slot])
             cr = unpack_account(acct_rows[cr_slot])
@@ -471,14 +526,19 @@ class LedgerKernels:
             p["fulfill"] = fulfill[p_slot]
             # The pending transfer's accounts (post/void path); garbage rows
             # when ~p_found, gated by the validator.
-            pdr_slot, _ = ht.lookup(
-                k4({"id_lo": p["dr_lo"], "id_hi": p["dr_hi"]}), acct_rows, self.a_log2
+            pdr_slot, _, res5 = ht.lookup(
+                k4({"id_lo": p["dr_lo"], "id_hi": p["dr_hi"]}), acct_rows,
+                self.a_log2, window=W,
             )
-            pcr_slot, _ = ht.lookup(
-                k4({"id_lo": p["cr_lo"], "id_hi": p["cr_hi"]}), acct_rows, self.a_log2
+            pcr_slot, _, res6 = ht.lookup(
+                k4({"id_lo": p["cr_lo"], "id_hi": p["cr_hi"]}), acct_rows,
+                self.a_log2, window=W,
             )
             pdr = unpack_account(acct_rows[pdr_slot])
             pcr = unpack_account(acct_rows[pcr_slot])
+            probe_bad = probe_bad | (
+                active & ~(res1 & res2 & res3 & res4 & res5 & res6)
+            )
 
             is_pv = (e["flags"] & jnp.uint32(F_POST | F_VOID)) != 0
             r_s, amt_s_lo, amt_s_hi = validate.validate_simple_transfer(
@@ -521,8 +581,9 @@ class LedgerKernels:
                 "ts": ts,
             }
             ins_row = pack_transfer(ins)
-            free_slot = ht.probe_free_scalar(row_e[:4], xfer_rows, self.t_log2)
-            w = jnp.where(ok, free_slot, t_dump)
+            free_slot, free_ok = ht.probe_free(row_e[:4], xfer_rows, self.t_log2)
+            probe_bad = probe_bad | (ok & ~free_ok)
+            w = jnp.where(ok & free_ok, free_slot, t_dump)
             xfer_rows = xfer_rows.at[w].set(ins_row)
             fulfill = fulfill.at[w].set(jnp.uint32(0))
             fw = jnp.where(ok & is_pv, p_slot, t_dump)
@@ -648,15 +709,16 @@ class LedgerKernels:
 
             return (
                 acct_rows, xfer_rows, fulfill, results, undo,
-                chain_start, chain_broken, commit_ts,
+                chain_start, chain_broken, commit_ts, probe_bad,
             ), None
 
-        (acct_rows, xfer_rows, fulfill, results, _, _, _, commit_ts), _ = jax.lax.scan(
-            step, carry0, (lanes, rows_b)
-        )
+        (acct_rows, xfer_rows, fulfill, results, _, _, _, commit_ts,
+         probe_bad), _ = jax.lax.scan(step, carry0, (lanes, rows_b))
         ok_n = jnp.sum((results == 0) & (lanes < n)).astype(U64)
         # commit_ts advanced on at-the-time-ok events and, like the oracle's
         # scopes, is NOT restored by chain rollback — return the carry as-is.
+        # An unresolved probe mid-scan cannot be rolled back: FAULT_SERIAL
+        # marks the state corrupt (host must discard it).
         return {
             **state,
             "acct_rows": acct_rows,
@@ -664,15 +726,18 @@ class LedgerKernels:
             "fulfill": fulfill,
             "commit_ts": commit_ts,
             "xfer_count": state["xfer_count"] + ok_n,
+            "fault": state["fault"]
+            | jnp.where(probe_bad, jnp.uint32(FAULT_SERIAL), jnp.uint32(0)),
         }, results
 
     # ------------------------------------------------------------------
     # create_accounts
     # ------------------------------------------------------------------
 
-    def _commit_accounts(self, state, ev, n, timestamp, mode: str = "auto"):
+    def _commit_accounts(self, state, ev, n, timestamp, mode: str = "fast"):
         if mode == "serial":
             return self._serial_accounts(state, ev, n, timestamp)
+        assert mode == "fast", mode
 
         rows_b = ev["rows"]
         B = rows_b.shape[0]
@@ -681,47 +746,50 @@ class LedgerKernels:
         valid = lane < n
         ts_vec = timestamp - n.astype(U64) + lane.astype(U64) + jnp.uint64(1)
 
-        ex_slot, ex_found = ht.lookup(rows_b[:, :4], state["acct_rows"], self.a_log2)
+        ex_slot, ex_found, ex_res = ht.lookup(
+            rows_b[:, :4], state["acct_rows"], self.a_log2
+        )
         ex = unpack_account(state["acct_rows"][ex_slot])
         r0 = jnp.where(e["ts"] != 0, jnp.uint32(3), jnp.uint32(0))
         r = validate.validate_create_account(r0, e, ex, ex_found)
         r = jnp.where(valid, r, jnp.uint32(0))
         ok = valid & (r == 0)
 
-        h_flags = jnp.any(valid & ((e["flags"] & jnp.uint32(validate.A_LINKED)) != 0))
-        h_dup = _has_duplicate_ids(rows_b[:, :4], valid)
-        hazard = h_flags | h_dup
-        ins_rows = _set_ts_words(rows_b, ts_vec)
-
-        def fast_branch(state):
-            slots, acct2, claim = ht.insert_rows(
-                ins_rows, ok, state["acct_rows"], state["acct_claim"], self.a_log2
-            )
-            any_ok = jnp.any(ok)
-            last_ts = jnp.max(jnp.where(ok, ts_vec, jnp.uint64(0)))
-            return {
-                **state,
-                "acct_rows": acct2,
-                "acct_claim": claim,
-                "commit_ts": jnp.where(any_ok, last_ts, state["commit_ts"]),
-                "acct_count": state["acct_count"] + jnp.sum(ok).astype(U64),
-            }, r
-
-        if mode == "fast":
-            return fast_branch(state)
-        return jax.lax.cond(
-            hazard,
-            lambda s: self._serial_accounts(s, ev, n, timestamp),
-            fast_branch,
-            state,
+        probe_bad = jnp.any(valid & ~ex_res)
+        ins_slots, claim, ins_res = ht.claim_slots(
+            rows_b[:, :4], ok, state["acct_rows"], state["acct_claim"], self.a_log2
         )
+        claim_bad = jnp.any(~ins_res)
+
+        fault = (
+            state["fault"]
+            | jnp.where(probe_bad, jnp.uint32(FAULT_PROBE), jnp.uint32(0))
+            | jnp.where(claim_bad, jnp.uint32(FAULT_CLAIM), jnp.uint32(0))
+        )
+        proceed = fault == 0
+
+        ins_rows = _set_ts_words(rows_b, ts_vec)
+        w = jnp.where(proceed & ok, ins_slots, self.a_dump)
+        acct2 = state["acct_rows"].at[w].set(ins_rows)
+        applied = proceed & jnp.any(ok)
+        last_ts = jnp.max(jnp.where(ok, ts_vec, jnp.uint64(0)))
+        return {
+            **state,
+            "acct_rows": acct2,
+            "acct_claim": claim,
+            "commit_ts": jnp.where(applied, last_ts, state["commit_ts"]),
+            "acct_count": state["acct_count"]
+            + jnp.where(proceed, jnp.sum(ok).astype(U64), jnp.uint64(0)),
+            "fault": fault,
+        }, r
 
     def _serial_accounts(self, state, ev, n, timestamp):
         rows_b = ev["rows"]
         B = rows_b.shape[0]
         lanes = jnp.arange(B, dtype=I32)
         a_dump = self.a_dump
-        tomb_row = jnp.asarray(_TOMB_ROW)
+        tomb_row = _TOMB_ROW  # numpy: embeds as a literal
+        n = jnp.where(state["fault"] == 0, n, jnp.int32(0))
 
         undo0 = {
             "slot": jnp.zeros(B, dtype=I32),
@@ -734,10 +802,12 @@ class LedgerKernels:
             jnp.int32(-1),
             jnp.zeros((), dtype=bool),
             state["commit_ts"],
+            jnp.zeros((), dtype=bool),  # unresolved-probe accumulator
         )
 
         def step(carry, x):
-            acct_rows, results, undo, chain_start, chain_broken, commit_ts = carry
+            (acct_rows, results, undo, chain_start, chain_broken, commit_ts,
+             probe_bad) = carry
             i, row_e = x
             e = unpack_account(row_e)
             active = i < n
@@ -753,14 +823,17 @@ class LedgerKernels:
             lad.set(active & chain_broken, 1)
             lad.set(e["ts"] != 0, 3)
 
-            ex_slot, ex_found = ht.lookup(row_e[:4], acct_rows, self.a_log2)
+            ex_slot, ex_found, ex_res = ht.lookup(
+                row_e[:4], acct_rows, self.a_log2, window=ht.WINDOW_SCALAR
+            )
             ex = unpack_account(acct_rows[ex_slot])
             r = validate.validate_create_account(lad.r, e, ex, ex_found)
             r = jnp.where(active, r, jnp.uint32(0))
             ok = active & (r == 0)
 
-            free_slot = ht.probe_free_scalar(row_e[:4], acct_rows, self.a_log2)
-            w = jnp.where(ok, free_slot, a_dump)
+            free_slot, free_ok = ht.probe_free(row_e[:4], acct_rows, self.a_log2)
+            probe_bad = probe_bad | (active & ~ex_res) | (ok & ~free_ok)
+            w = jnp.where(ok & free_ok, free_slot, a_dump)
             t0, t1 = _lohi(ts)
             ins_row = jnp.concatenate([row_e[:30], t0[None], t1[None]])
             acct_rows = acct_rows.at[w].set(ins_row)
@@ -788,9 +861,10 @@ class LedgerKernels:
             chain_end = in_chain & (~linked | (r == 2))
             chain_start = jnp.where(chain_end, jnp.int32(-1), chain_start)
             chain_broken = jnp.where(chain_end, False, chain_broken)
-            return (acct_rows, results, undo, chain_start, chain_broken, commit_ts), None
+            return (acct_rows, results, undo, chain_start, chain_broken,
+                    commit_ts, probe_bad), None
 
-        (acct_rows, results, _, _, _, commit_ts), _ = jax.lax.scan(
+        (acct_rows, results, _, _, _, commit_ts, probe_bad), _ = jax.lax.scan(
             step, carry0, (lanes, rows_b)
         )
         ok_n = jnp.sum((results == 0) & (lanes < n)).astype(U64)
@@ -799,6 +873,8 @@ class LedgerKernels:
             "acct_rows": acct_rows,
             "commit_ts": commit_ts,
             "acct_count": state["acct_count"] + ok_n,
+            "fault": state["fault"]
+            | jnp.where(probe_bad, jnp.uint32(FAULT_SERIAL), jnp.uint32(0)),
         }, results
 
     # ------------------------------------------------------------------
@@ -806,12 +882,12 @@ class LedgerKernels:
     # ------------------------------------------------------------------
 
     def _lookup_accounts(self, state, ids):
-        slot, found = ht.lookup(ids["key4"], state["acct_rows"], self.a_log2)
-        return found, state["acct_rows"][slot]
+        slot, found, res = ht.lookup(ids["key4"], state["acct_rows"], self.a_log2)
+        return found, state["acct_rows"][slot], jnp.all(res)
 
     def _lookup_transfers(self, state, ids):
-        slot, found = ht.lookup(ids["key4"], state["xfer_rows"], self.t_log2)
-        return found, state["xfer_rows"][slot]
+        slot, found, res = ht.lookup(ids["key4"], state["xfer_rows"], self.t_log2)
+        return found, state["xfer_rows"][slot], jnp.all(res)
 
 
 # ----------------------------------------------------------------------
@@ -826,11 +902,125 @@ def _next_pow2(n: int, floor: int = 8) -> int:
     return p
 
 
+class HazardTracker:
+    """Host-side, EXACT fast-tier admission control. Tracks the two facts
+    that cannot be read off a batch alone — balance-limit account ids and the
+    running amount-sum overflow bound — and decides per batch whether the
+    vectorized tier is sound (see the module docstring's fast-tier list).
+    Shared by the single-chip DeviceLedger and the sharded ledger."""
+
+    def __init__(self):
+        # Ids of accounts created with balance-limit flags (account flags are
+        # immutable after creation, so membership is stable). Kept as sorted
+        # u64 limb columns so the hot-path membership test is vectorized.
+        self.limit_account_ids: set[int] = set()
+        self._limit_lo = np.empty(0, dtype=np.uint64)
+        # Running sum of every transfer amount ever submitted. While this
+        # exact upper bound on any balance stays < 2^127, no u128 balance sum
+        # can overflow, so overflow codes 47-52 can only arise from per-event
+        # validation against pre-batch balances — which the vectorized ladder
+        # computes exactly.
+        self.amount_sum = 0
+
+    @staticmethod
+    def has_dup_ids(arr: np.ndarray) -> bool:
+        # Fast path: sort a 64-bit hash-fold of the u128 ids; if no two
+        # hashes collide there are certainly no duplicate ids. Only on a
+        # hash collision (~B^2/2^64 per batch) fall back to the exact
+        # 16-byte comparison. Exact overall, ~15x cheaper than np.unique
+        # over 16-byte voids on the hot path.
+        with np.errstate(over="ignore"):
+            h = arr["id_lo"] ^ (arr["id_hi"] * np.uint64(0x9E3779B97F4A7C15))
+        h.sort()
+        if not (h[1:] == h[:-1]).any():
+            return False
+        ids = np.ascontiguousarray(
+            np.stack([arr["id_lo"], arr["id_hi"]], axis=1)
+        ).view("V16")
+        return len(np.unique(ids)) < len(arr)
+
+    def transfers_hazard(self, arr: np.ndarray) -> bool:
+        """True if the batch needs the serial tier. Exact conditions."""
+        # Exact overflow bound: sum every amount as a Python int (u64 column
+        # sums cannot wrap: 2^13 values < 2^32 per 32-bit half). Counted for
+        # EVERY batch, serial-tier ones included, so the running sum is an
+        # upper bound on any balance the store can hold: posts move pending
+        # to posted, voids remove, balancing clamps to available <= sum.
+        lo, hi = arr["amount_lo"], arr["amount_hi"]
+        batch_sum = (
+            int(np.sum(lo & np.uint64(0xFFFFFFFF), dtype=np.uint64))
+            + (int(np.sum(lo >> np.uint64(32), dtype=np.uint64)) << 32)
+            + ((int(np.sum(hi & np.uint64(0xFFFFFFFF), dtype=np.uint64))
+                + (int(np.sum(hi >> np.uint64(32), dtype=np.uint64)) << 32)) << 64)
+        )
+        self.amount_sum += batch_sum
+        if self.amount_sum >= (1 << 127):
+            return True  # conservative: overflow no longer provably impossible
+        if (arr["flags"] & _SLOW_FLAGS).any():
+            return True
+        if self.has_dup_ids(arr):
+            return True
+        if self.limit_account_ids:
+            lo2 = np.concatenate(
+                [arr["debit_account_id_lo"], arr["credit_account_id_lo"]]
+            )
+            hi2 = np.concatenate(
+                [arr["debit_account_id_hi"], arr["credit_account_id_hi"]]
+            )
+            # Vectorized membership: candidate lanes whose lo limb appears in
+            # the sorted limit-lo column, then confirm the hi limb.
+            pos = np.searchsorted(self._limit_lo, lo2)
+            pos_c = np.minimum(pos, len(self._limit_lo) - 1)
+            cand = (self._limit_lo[pos_c] == lo2)
+            if cand.any():
+                for lo_, hi_ in zip(lo2[cand], hi2[cand]):
+                    if (int(lo_) | (int(hi_) << 64)) in self.limit_account_ids:
+                        return True
+        return False
+
+    def accounts_hazard(self, arr: np.ndarray) -> bool:
+        if (arr["flags"] & validate.A_LINKED).any():
+            return True
+        return self.has_dup_ids(arr)
+
+    def note_limit_accounts(self, arr: np.ndarray) -> None:
+        limit_bits = validate.A_DR_LIMIT | validate.A_CR_LIMIT
+        sel = (arr["flags"] & limit_bits) != 0
+        if not sel.any():
+            return
+        for lo, hi in zip(arr["id_lo"][sel], arr["id_hi"][sel]):
+            self.limit_account_ids.add(int(lo) | (int(hi) << 64))
+        self._limit_lo = np.sort(
+            np.concatenate([self._limit_lo, arr["id_lo"][sel].astype(np.uint64)])
+        )
+
+
+class PendingBatch:
+    """Handle for an asynchronously dispatched commit (results still on
+    device). The driver's pipelining unit — the analog of one in-flight
+    prepare in the reference's pipeline (reference:
+    src/vsr/replica.zig:5102-5186, pipeline_prepare_queue_max=8)."""
+
+    __slots__ = ("operation", "n", "results")
+
+    def __init__(self, operation, n, results):
+        self.operation = operation
+        self.n = n
+        self.results = results  # device u32 [n_pad]
+
+
 class DeviceLedger:
     """Host wrapper: owns the device state and mirrors the oracle's execute()
     API so the two are drop-in interchangeable in parity tests and in the VSR
     commit path (reference lifecycle: src/state_machine.zig:336-540
-    prepare/commit; prefetch is subsumed by HBM residency)."""
+    prepare/commit; prefetch is subsumed by HBM residency).
+
+    `mode`:
+    - "auto" (production): the host PROVES each batch hazard-free (see
+      _transfers_hazard) and dispatches the vectorized kernel, else the exact
+      serial kernel. Nothing data-dependent runs on device.
+    - "fast" / "serial": force one tier (parity testing).
+    """
 
     def __init__(
         self,
@@ -845,14 +1035,17 @@ class DeviceLedger:
         self.state = init_state(process)
         self.prepare_timestamp = 0
         self.pad_to: int | None = None  # fix the batch pad (bench: 8192)
-        # Host-tracked occupancy for the load-factor guard (7/8 max). A full
-        # table would make probe chains unbounded and inserts lossy; the
-        # reference sizes its object pools statically for the same reason
-        # (reference: src/static_allocator.zig, src/message_pool.zig:18-41).
+        # Host-tracked occupancy for the load-factor guard (1/2 max — the
+        # probe-window unresolve probability is ~alpha^window, so alpha <= 1/2
+        # with window 32 makes window overflow a ~2^-32 event; see
+        # ops/hashtable.py). The reference sizes its object pools statically
+        # for the same class of reason (reference: src/static_allocator.zig,
+        # src/message_pool.zig:18-41).
         self._acct_used = 0
         self._xfer_used = 0
-        self._acct_limit = (1 << process.account_slots_log2) * 7 // 8
-        self._xfer_limit = (1 << process.transfer_slots_log2) * 7 // 8
+        self._acct_limit = (1 << process.account_slots_log2) // 2
+        self._xfer_limit = (1 << process.transfer_slots_log2) // 2
+        self.hazards = HazardTracker()
 
     def prepare(self, operation: Operation, event_count: int) -> None:
         if operation in (Operation.create_accounts, Operation.create_transfers):
@@ -861,11 +1054,18 @@ class DeviceLedger:
     def _pad_for(self, n: int) -> int:
         return self.pad_to if self.pad_to is not None else _next_pow2(n)
 
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+
     def execute(self, operation, timestamp: int, events: list) -> list[tuple[int, int]]:
         dense = self.execute_dense(operation, timestamp, events)
         return [(i, c) for i, c in enumerate(dense) if c]
 
-    def execute_dense(self, operation, timestamp: int, events) -> list[int]:
+    def execute_async(self, operation, timestamp: int, events) -> PendingBatch:
+        """Dispatch a commit without any device->host synchronization.
+        The caller materializes results later (results stay on device) and
+        MUST call check_fault() at least once after the last drain."""
         n = len(events)
         n_pad = self._pad_for(n)
         assert n <= n_pad
@@ -879,10 +1079,14 @@ class DeviceLedger:
                     "grow ConfigProcess.transfer_slots_log2"
                 )
             arr = events if isinstance(events, np.ndarray) else types.transfers_to_np(events)
+            mode = self.mode
+            if mode == "auto":
+                mode = "serial" if self.hazards.transfers_hazard(arr) else "fast"
             batch = transfers_to_batch(arr, n_pad)
             self.state, results = self.kernels.commit_transfers(
-                self.state, batch, nn, ts, mode=self.mode
+                self.state, batch, nn, ts, mode=mode
             )
+            self._xfer_used += n  # upper bound; exact count reconciled on drain
         elif operation == Operation.create_accounts:
             if self._acct_used + n > self._acct_limit:
                 raise RuntimeError(
@@ -891,23 +1095,41 @@ class DeviceLedger:
                     "grow ConfigProcess.account_slots_log2"
                 )
             arr = events if isinstance(events, np.ndarray) else types.accounts_to_np(events)
+            mode = self.mode
+            if mode == "auto":
+                mode = "serial" if self.hazards.accounts_hazard(arr) else "fast"
+            self.hazards.note_limit_accounts(arr)
             batch = accounts_to_batch(arr, n_pad)
             self.state, results = self.kernels.commit_accounts(
-                self.state, batch, nn, ts, mode=self.mode
+                self.state, batch, nn, ts, mode=mode
             )
+            self._acct_used += n
         else:
             raise AssertionError(operation)
-        dense = [int(x) for x in np.asarray(results)[:n]]
-        ok_n = sum(1 for c in dense if c == 0)
+        return PendingBatch(operation, n, results)
+
+    def check_fault(self) -> None:
+        """Raise if the device hit the fault protocol (see module docstring).
+        Synchronizes with the device — amortize on the hot path."""
+        raise_on_fault(int(np.asarray(self.state["fault"])), "device ledger")
+
+    def execute_dense(self, operation, timestamp: int, events) -> list[int]:
+        pending = self.execute_async(operation, timestamp, events)
+        dense = [int(x) for x in np.asarray(pending.results)[: pending.n]]
+        self.check_fault()
+        # Reconcile the conservative load estimate with the exact ok-count.
+        fail_n = sum(1 for c in dense if c != 0)
         if operation == Operation.create_transfers:
-            self._xfer_used += ok_n
+            self._xfer_used -= fail_n
         else:
-            self._acct_used += ok_n
+            self._acct_used -= fail_n
         return dense
 
     def _lookup(self, kernel, ids: list[int]):
         n_pad = self._pad_for(len(ids))
-        found, rows = kernel(self.state, ids_to_batch(ids, n_pad))
+        found, rows, resolved = kernel(self.state, ids_to_batch(ids, n_pad))
+        if not bool(resolved):
+            raise RuntimeError("lookup probe-window overflow: grow the table")
         found = np.asarray(found)[: len(ids)]
         rows = np.asarray(rows)[: len(ids)]
         return found, rows

@@ -1,4 +1,4 @@
-"""HBM-resident open-addressing hash tables over 128-byte wire-layout rows.
+"""HBM-resident hash tables over 128-byte wire-layout rows — straight-line probes.
 
 This is the TPU-native replacement for the reference's Groove object store +
 CacheMap (reference: src/lsm/groove.zig:602-760, src/lsm/cache_map.zig): the
@@ -7,34 +7,63 @@ table, each row being the object's 128-byte little-endian wire format
 (reference: src/tigerbeetle.zig:7-104) — so a host batch uploads as one
 bitcast and a probe fetches a whole object in one gather.
 
-Why u32 rows: on TPU, XLA lowers 64-bit gathers/scatters to per-index scalar
-DMAs (~100us per op for an 8k batch), while u32 row gathers vectorize
-(~10us). All storage is u32; arithmetic widens to u64 limbs after gathering
-(elementwise widening is cheap).
+Design constraints discovered on the target stack (and why this file has NO
+lax.while_loop / lax.cond / data-dependent trip counts):
 
-Slot `capacity` is a write dump for masked scatters (never read). Probing is
-linear with a batched while_loop. Key encoding in row words 0..3 (the id):
+- Plain gathers/scatters over multi-GiB tables are fast (~30us for an
+  8k-lane batch), including window gathers of [B, W, 4] probe keys.
+- A gather INSIDE a while_loop/scan body permanently degrades the process's
+  dispatch path (every subsequent kernel launch ~12ms instead of ~30us) —
+  measured, reproducible, and fatal for throughput. Data-dependent probe
+  continuation loops are therefore banned from every device kernel.
+
+So probing is **double hashing with a fixed probe window**: probe j visits
+`(h1(key) + j * step(key)) & mask` with `step` odd (coprime to the power-of-2
+capacity, so the sequence visits every slot). All W probes for all lanes are
+fetched in ONE window gather and resolved branch-free. Double hashing (vs
+linear probing) makes chain-length tails geometric with NO clustering:
+P(chain >= W) ~ alpha^W, so with the enforced load factor alpha <= 1/2
+(constants.LOAD_FACTOR_*) and W = 32, an unresolved probe is a ~2^-32 event
+per op. Unresolved lanes are reported to the caller, which must abort the
+whole batch (no partial application) and raise a sticky fault — see
+models/ledger.py's fault protocol.
+
+Key encoding in row words 0..3 (the id):
 - empty slot:     all four words 0  (valid ids are never 0)
 - tombstone slot: all four words 0xFFFFFFFF  (valid ids are never u128 max;
   both invariants enforced by id_must_not_be_zero / id_must_not_be_int_max,
   reference: src/tigerbeetle.zig:118-121, 160-163)
-Tombstones arise only from linked-chain rollback deletions; lookups skip
-them, inserts reuse them.
+Tombstones arise only from linked-chain rollback deletions: probes skip them
+(only an EMPTY slot terminates a chain), inserts reuse them.
 """
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
+import numpy as np
 
 U64 = jnp.uint64
 U32 = jnp.uint32
 I32 = jnp.int32
 
-TOMB_WORD = jnp.uint32(0xFFFFFFFF)
-CLAIM_FREE = jnp.uint32(0xFFFFFFFF)
+# NOTE: module-level constants MUST be numpy (not jnp): a jitted function
+# that captures a concrete jax array permanently degrades the process's
+# dispatch path on the tunneled-TPU runtime (measured: every subsequent
+# kernel launch ~12 ms instead of ~30 us). numpy scalars embed as XLA
+# literals instead of captured device buffers.
+TOMB_WORD = np.uint32(0xFFFFFFFF)
+CLAIM_FREE = np.uint32(0xFFFFFFFF)
 
-_MIX = jnp.uint64(0x9E3779B97F4A7C15)
+_MIX = np.uint64(0x9E3779B97F4A7C15)
+_MIX2 = np.uint64(0xD1B54A32D192ED03)
+
+# Fixed probe windows. Batched table ops probe WINDOW slots in one gather;
+# scalar probes (the serial scan kernel) use the longer WINDOW_SCALAR prefix
+# of the same probe sequence — a longer window is near-free for one lane and
+# makes a serial-tier unresolved probe (which cannot be rolled back mid-scan)
+# a ~2^-64 event.
+WINDOW = 32
+WINDOW_SCALAR = 64
 
 
 def key4_of_rows(rows):
@@ -42,11 +71,16 @@ def key4_of_rows(rows):
     return rows[..., :4]
 
 
-def hash_key4(key4, cap_log2: int):
-    """splitmix64 finalizer over both id limbs -> slot in [0, 2^cap_log2)."""
+def _fold64(key4):
     k = key4.astype(U64)
     lo = k[..., 0] | (k[..., 1] << jnp.uint64(32))
     hi = k[..., 2] | (k[..., 3] << jnp.uint64(32))
+    return lo, hi
+
+
+def hash_key4(key4, cap_log2: int):
+    """splitmix64 finalizer over both id limbs -> base slot in [0, 2^cap_log2)."""
+    lo, hi = _fold64(key4)
     x = lo ^ (hi * _MIX)
     x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
     x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
@@ -54,8 +88,24 @@ def hash_key4(key4, cap_log2: int):
     return (x & jnp.uint64((1 << cap_log2) - 1)).astype(I32)
 
 
-def _key_eq(a4, b4):
-    return jnp.all(a4 == b4, axis=-1)
+def probe_step(key4, cap_log2: int):
+    """Second, independent hash -> ODD probe stride (odd strides are units
+    mod 2^cap_log2, so the probe sequence is a full cycle)."""
+    lo, hi = _fold64(key4)
+    x = (lo ^ jnp.uint64(0x6A09E667F3BCC909)) * _MIX2
+    x = x ^ (hi * _MIX2) ^ (x >> jnp.uint64(31))
+    x = (x ^ (x >> jnp.uint64(29))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = x ^ (x >> jnp.uint64(32))
+    return ((x & jnp.uint64((1 << cap_log2) - 1)) | jnp.uint64(1)).astype(I32)
+
+
+def probe_positions(key4, cap_log2: int, window: int):
+    """[..., window] i32 slots: the first `window` probes of key4's sequence."""
+    mask = jnp.int32((1 << cap_log2) - 1)
+    base = hash_key4(key4, cap_log2)
+    step = probe_step(key4, cap_log2)
+    j = jnp.arange(window, dtype=I32)
+    return (base[..., None] + j * step[..., None]) & mask
 
 
 def _is_empty(k4):
@@ -66,138 +116,126 @@ def _is_tomb(k4):
     return jnp.all(k4 == TOMB_WORD, axis=-1)
 
 
-LOOKUP_UNROLL = 8
+def lookup(key4, rows, cap_log2: int, window: int = WINDOW):
+    """Probe for key4 ([..., 4] u32; batched or scalar). ONE window gather,
+    branch-free resolve. Returns (slot i32, found bool, resolved bool):
 
+    - found: the key is in the table; `slot` is its row.
+    - not found but resolved: an EMPTY slot terminated the chain before any
+      hit; `slot` is the first free (empty or tombstone) probe position —
+      the insert target for this key.
+    - not resolved (~2^-window per op at load <= 1/2): no hit and no empty
+      within the window; `slot` is arbitrary. The CALLER must treat the
+      whole batch as failed (fault protocol) — results are unsound.
 
-def lookup(key4, rows, cap_log2: int, unroll: int = LOOKUP_UNROLL):
-    """Batched (or scalar) probe. Returns (slot i32, found bool).
-
-    The first `unroll` probe steps are straight-line code (a TPU while_loop
-    costs ~0.3ms per iteration in scalar-core sync, so data-dependent trip
-    counts are poison for the common case); a while_loop continuation runs
-    under lax.cond only if some lane's chain is longer — vanishingly rare at
-    the enforced <= 7/8 load factor.
-
-    When not found, `slot` is the first empty slot of the probe chain (or an
-    arbitrary probed slot if the scan hit the probe bound) — callers must
-    gate on `found`.
+    Keys that are themselves empty/tomb-encoded (all-0s / all-1s ids) are
+    never reported found; they resolve like ordinary absent keys.
     """
-    mask = jnp.int32((1 << cap_log2) - 1)
-    idx = hash_key4(key4, cap_log2)
+    pos = probe_positions(key4, cap_log2, window)  # [..., W]
+    k4 = rows[pos, :4]  # [..., W, 4]
     key_probeable = ~_is_empty(key4) & ~_is_tomb(key4)
-    done = jnp.zeros(idx.shape, dtype=bool)
-    found = jnp.zeros(idx.shape, dtype=bool)
+    hit = jnp.all(k4 == key4[..., None, :], axis=-1) & key_probeable[..., None]
+    empty = _is_empty(k4)
+    free = empty | _is_tomb(k4)
 
-    def probe_once(idx, done, found):
-        k4 = rows[idx, :4]  # key words only — 16B per probed slot
-        hit = _key_eq(k4, key4) & key_probeable
-        empty = _is_empty(k4)
-        newly = ~done & (hit | empty)
-        found = jnp.where(newly, hit, found)
-        done = done | newly
-        idx = jnp.where(done, idx, (idx + 1) & mask)
-        return idx, done, found
+    j = jnp.arange(window, dtype=I32)
+    big = jnp.int32(window)
+    hit_j = jnp.min(jnp.where(hit, j, big), axis=-1)
+    empty_j = jnp.min(jnp.where(empty, j, big), axis=-1)
+    free_j = jnp.min(jnp.where(free, j, big), axis=-1)
 
-    for _ in range(min(unroll, 1 << cap_log2)):
-        idx, done, found = probe_once(idx, done, found)
-
-    def continuation(carry):
-        def cond(c):
-            _, done, _, steps = c
-            return (~jnp.all(done)) & (steps <= mask)
-
-        def body(c):
-            idx, done, found, steps = c
-            idx, done, found = probe_once(idx, done, found)
-            return idx, done, found, steps + 1
-
-        idx, done, found, _ = jax.lax.while_loop(
-            cond, body, (*carry, jnp.int32(0))
-        )
-        return idx, done, found
-
-    idx, _, found = jax.lax.cond(
-        jnp.all(done), lambda c: c, continuation, (idx, done, found)
-    )
-    return idx, found
+    found = hit_j < empty_j  # a hit before the chain terminator
+    resolved = found | (empty_j < big)
+    sel = jnp.where(found, hit_j, jnp.minimum(free_j, big - 1))
+    slot = jnp.take_along_axis(pos, sel[..., None], axis=-1)[..., 0]
+    return slot, found, resolved
 
 
-def insert_rows(row32, active, rows, claim, cap_log2: int):
-    """Claim one distinct slot per active lane and write the full 32-word row
-    there, for batch-unique, absent keys (id = row words 0..3).
+def claim_slots(key4, active, rows, claim, cap_log2: int,
+                window: int = WINDOW, rounds: int = 4):
+    """Claim one distinct free slot per active lane for batch-unique, absent
+    keys (the parallel-insert slot assignment). Pure claim phase: the rows
+    table is NOT written — the caller scatters the rows after gating on
+    `resolved` (so an aborting batch leaves the table untouched).
 
-    Returns (slots i32 [B] — dump slot for inactive lanes, rows', claim').
-    Probe races between lanes are resolved deterministically by scatter-min of
-    the lane index into the persistent `claim` scratch column (reset to
-    CLAIM_FREE before return). Losing lanes observe the winner's key on the
-    next iteration and probe on.
+    Returns (slots i32 [B], claim', resolved bool [B]). `slots` is the dump
+    slot (capacity) for inactive or unresolved lanes. `claim` is the
+    persistent [capacity+1] u32 scratch column (CLAIM_FREE everywhere between
+    batches); claims are held across rounds as in-batch occupancy and all
+    released before return.
+
+    Races between lanes probing the same slot are resolved deterministically
+    by scatter-min of the lane index; a losing lane's next round recomputes
+    its first free-and-unclaimed probe position (the lost slot is now
+    claimed, so it is skipped automatically). With double hashing, two lanes
+    share more than one probe position only on a ~2^-64 hash collision, so
+    `rounds` bounds the CONTENTION depth, not chain length; unresolved lanes
+    after `rounds` rounds are reported, not retried.
     """
     cap = 1 << cap_log2
-    mask = jnp.int32(cap - 1)
     dump = jnp.int32(cap)
-    B = row32.shape[0]
+    B = key4.shape[0]
     lanes = jnp.arange(B, dtype=U32)
+
+    pos = probe_positions(key4, cap_log2, window)  # [B, W]
+    k4 = rows[pos, :4]
+    table_free = _is_empty(k4) | _is_tomb(k4)  # [B, W] — static during claims
+
+    j = jnp.arange(window, dtype=I32)
+    big = jnp.int32(window)
+
+    won = jnp.zeros(B, dtype=bool)
+    slot = jnp.full(B, dump, dtype=I32)
+    for _ in range(rounds):
+        clm_w = claim[pos]  # [B, W] — refreshed each round
+        cand_j = jnp.min(
+            jnp.where(table_free & (clm_w == CLAIM_FREE), j, big), axis=-1
+        )
+        has_cand = cand_j < big
+        cand = jnp.take_along_axis(
+            pos, jnp.minimum(cand_j, big - 1)[:, None], axis=-1
+        )[:, 0]
+        want = active & ~won & has_cand
+        tgt = jnp.where(want, cand, dump)
+        claim = claim.at[tgt].min(lanes)
+        newly = want & (claim[cand] == lanes)
+        slot = jnp.where(newly, cand, slot)
+        won = won | newly
+
+    resolved = won | ~active
+    # Release every claim this batch made: winners' slots + the dump slot
+    # (losing lanes' scatter-min landed on slots that some lane won, or on
+    # the dump slot — both covered).
+    claim = claim.at[slot].set(CLAIM_FREE).at[dump].set(CLAIM_FREE)
+    return slot, claim, resolved
+
+
+def probe_free(key4, rows, cap_log2: int, window: int = WINDOW_SCALAR):
+    """First free (empty or tombstone) probe position for a key known to be
+    absent (the serial scan kernel's insert target; it masks its own writes).
+    Returns (slot, ok). One window gather, no loops."""
+    pos = probe_positions(key4, cap_log2, window)
+    k4 = rows[pos, :4]
+    free = _is_empty(k4) | _is_tomb(k4)
+    j = jnp.arange(window, dtype=I32)
+    big = jnp.int32(window)
+    free_j = jnp.min(jnp.where(free, j, big), axis=-1)
+    ok = free_j < big
+    sel = jnp.minimum(free_j, big - 1)
+    slot = jnp.take_along_axis(pos, sel[..., None], axis=-1)[..., 0]
+    return slot, ok
+
+
+def insert_rows(row32, active, rows, claim, cap_log2: int,
+                window: int = WINDOW, rounds: int = 4):
+    """claim_slots + row scatter in one call (convenience for callers that
+    gate on `resolved` themselves AFTER the write — e.g. test harnesses).
+    Production kernels should use claim_slots and scatter after gating.
+
+    Returns (slots, rows', claim', resolved)."""
     key4 = key4_of_rows(row32)
-    idx = hash_key4(key4, cap_log2)
-    done0 = ~active
-
-    # Claims are HELD across rounds as in-batch occupancy (claim[slot] != FREE
-    # means "taken by this batch"), so the table itself is never written during
-    # probing — each round is just three cheap u32 gathers/scatters. Every
-    # claimed slot has a winner, so the final reset at `slots` frees them all.
-    def claim_once(idx, done, clm):
-        k4 = rows[idx, :4]
-        table_free = _is_empty(k4) | _is_tomb(k4)
-        want = ~done & table_free & (clm[idx] == CLAIM_FREE)
-        clm = clm.at[jnp.where(want, idx, dump)].min(lanes)
-        won = want & (clm[idx] == lanes)
-        done = done | won
-        idx = jnp.where(done, idx, (idx + 1) & mask)
-        return idx, done, clm
-
-    idx, done, clm = (idx, done0, claim)
-    for _ in range(min(LOOKUP_UNROLL, 1 << cap_log2)):
-        idx, done, clm = claim_once(idx, done, clm)
-
-    def continuation(carry):
-        def cond(c):
-            _, done, _, steps = c
-            return (~jnp.all(done)) & (steps <= mask)
-
-        def body(c):
-            idx, done, clm, steps = c
-            idx, done, clm = claim_once(idx, done, clm)
-            return idx, done, clm, steps + 1
-
-        idx, done, clm, _ = jax.lax.while_loop(cond, body, (*carry, jnp.int32(0)))
-        return idx, done, clm
-
-    idx, done, clm = jax.lax.cond(
-        jnp.all(done), lambda c: c, continuation, (idx, done, clm)
+    slots, claim, resolved = claim_slots(
+        key4, active, rows, claim, cap_log2, window=window, rounds=rounds
     )
-    slots = jnp.where(active & done, idx, dump)
     rows = rows.at[slots].set(row32)
-    # Reset won slots + the dump slot (non-want lanes min-scatter there).
-    claim = clm.at[slots].set(CLAIM_FREE).at[dump].set(CLAIM_FREE)
-    return slots, rows, claim
-
-
-def probe_free_scalar(key4, rows, cap_log2: int):
-    """Read-only scalar probe to the first free (empty or tombstone) slot of
-    the key's probe chain (for the serial scan kernel, which masks its own
-    writes). The key must be absent from the table."""
-    mask = jnp.int32((1 << cap_log2) - 1)
-    idx = hash_key4(key4, cap_log2)
-
-    def cond(carry):
-        idx, steps = carry
-        k4 = key4_of_rows(rows[idx])
-        free = _is_empty(k4) | _is_tomb(k4)
-        return (~free) & (steps <= mask)
-
-    def body(carry):
-        idx, steps = carry
-        return (idx + 1) & mask, steps + 1
-
-    idx, _ = jax.lax.while_loop(cond, body, (idx, jnp.int32(0)))
-    return idx
+    return slots, rows, claim, resolved

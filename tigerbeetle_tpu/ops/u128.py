@@ -10,12 +10,15 @@ carry/borrow bits where overflow matters.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 U64 = jnp.uint64
 U32 = jnp.uint32
 
-_ONE = jnp.uint64(1)
-_ZERO = jnp.uint64(0)
+# numpy, not jnp: captured concrete jax arrays poison dispatch (see
+# ops/hashtable.py note).
+_ONE = np.uint64(1)
+_ZERO = np.uint64(0)
 
 
 def add(a_lo, a_hi, b_lo, b_hi):
