@@ -1,0 +1,109 @@
+"""Wire-level StateMachine: all four ops through one entry point, reply
+bytes identical between the oracle backend and the device backend
+(reference: src/tigerbeetle.zig:231-249 result structs,
+src/state_machine.zig:701-736 lookups)."""
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import TEST_PROCESS
+from tigerbeetle_tpu.models.ledger import DeviceLedger
+from tigerbeetle_tpu.models.oracle import OracleStateMachine
+from tigerbeetle_tpu.state_machine import (
+    StateMachine,
+    decode_ids,
+    decode_results,
+    encode_ids,
+    encode_results,
+)
+from tigerbeetle_tpu.testing.workload import WorkloadGenerator
+from tigerbeetle_tpu.types import Operation
+
+
+def test_result_encoding_roundtrip():
+    sparse = [(0, 21), (5, 1), (8190, 46)]
+    body = encode_results(sparse, Operation.create_transfers)
+    assert len(body) == 8 * len(sparse)
+    assert decode_results(body, Operation.create_transfers) == sparse
+    # Little-endian u32 pairs on the wire.
+    arr = np.frombuffer(body, dtype="<u4")
+    assert list(arr[:2]) == [0, 21]
+
+
+def test_id_encoding_roundtrip():
+    ids = [1, (1 << 128) - 2, 0xDEADBEEF << 64]
+    body = encode_ids(ids)
+    assert len(body) == 16 * len(ids)
+    assert decode_ids(body) == ids
+
+
+def test_wire_parity_all_ops():
+    oracle = StateMachine(OracleStateMachine())
+    dev = StateMachine(DeviceLedger(process=TEST_PROCESS, mode="auto"))
+    gen = WorkloadGenerator(11)
+    ts = 1_000_000_000
+
+    for b in range(8):
+        if b % 3 == 0:
+            op, events = gen.gen_accounts_batch(24)
+            body = types.accounts_to_np(events).tobytes()
+        else:
+            op, events = gen.gen_transfers_batch(24)
+            body = types.transfers_to_np(events).tobytes()
+        assert oracle.input_valid(op, body) and dev.input_valid(op, body)
+        assert oracle.input_count(op, body) == len(events)
+        ts += len(events)
+        reply_o = oracle.commit(op, ts, body)
+        reply_d = dev.commit(op, ts, body)
+        assert reply_o == reply_d, f"batch {b} ({op.name})"
+
+    for kind in ("accounts", "transfers"):
+        op, ids = gen.gen_lookup_batch(30, kind)
+        body = encode_ids(ids)
+        assert oracle.input_valid(op, body)
+        reply_o = oracle.commit(op, ts, body)
+        reply_d = dev.commit(op, ts, body)
+        assert reply_o == reply_d, kind
+        assert len(reply_o) % 128 == 0
+
+
+def test_sparse_encoding_matches_oracle_sparse():
+    """The dense->sparse conversion must equal the oracle's native sparse
+    output, including FIFO-ordered chain rollback entries."""
+    from tigerbeetle_tpu.types import Account, Transfer
+
+    o1 = OracleStateMachine()
+    o2 = OracleStateMachine()
+    sm = StateMachine(o2)
+    ts = 10_000
+    accounts = [Account(id=i, ledger=1, code=1) for i in (1, 2, 3)]
+    ts += 3
+    o1.execute(Operation.create_accounts, ts, accounts)
+    sm.commit(Operation.create_accounts, ts, types.accounts_to_np(accounts).tobytes())
+
+    # linked chain failing at the end -> rollback entries precede the failure.
+    transfers = [
+        Transfer(id=10, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=1, flags=1),
+        Transfer(id=11, debit_account_id=2, credit_account_id=3, amount=7,
+                 ledger=1, code=1, flags=1),
+        Transfer(id=12, debit_account_id=1, credit_account_id=3, amount=0,
+                 ledger=1, code=1),
+    ]
+    ts += 3
+    sparse_native = o1.execute(Operation.create_transfers, ts, transfers)
+    reply = sm.commit(
+        Operation.create_transfers, ts, types.transfers_to_np(transfers).tobytes()
+    )
+    assert decode_results(reply, Operation.create_transfers) == sparse_native
+    assert sparse_native == [(0, 1), (1, 1), (2, 18)]
+
+
+def test_input_validation():
+    sm = StateMachine(OracleStateMachine())
+    assert not sm.input_valid(Operation.create_accounts, b"")
+    assert not sm.input_valid(Operation.create_accounts, b"x" * 127)
+    assert not sm.input_valid(Operation.lookup_accounts, b"x" * 15)
+    assert not sm.input_valid(Operation.create_accounts, b"\0" * 128 * 8192)
+    assert sm.input_valid(Operation.create_accounts, b"\0" * 128 * 8191)
+    assert not sm.input_valid(Operation.register, b"")

@@ -988,11 +988,86 @@ class HazardTracker:
         sel = (arr["flags"] & limit_bits) != 0
         if not sel.any():
             return
+        new_lo = []
         for lo, hi in zip(arr["id_lo"][sel], arr["id_hi"][sel]):
-            self.limit_account_ids.add(int(lo) | (int(hi) << 64))
-        self._limit_lo = np.sort(
-            np.concatenate([self._limit_lo, arr["id_lo"][sel].astype(np.uint64)])
-        )
+            key = int(lo) | (int(hi) << 64)
+            if key not in self.limit_account_ids:  # dedup: retries re-submit
+                self.limit_account_ids.add(key)
+                new_lo.append(lo)
+        if new_lo:
+            self._limit_lo = np.sort(
+                np.concatenate([self._limit_lo, np.array(new_lo, dtype=np.uint64)])
+            )
+
+
+class HostLedgerBase:
+    """Shared host-side driver surface of the single-chip and sharded
+    ledgers: prepare-timestamp bookkeeping (reference:
+    src/state_machine.zig:336-343) and the lookup wrappers (reference:
+    src/state_machine.zig:701-736). Subclasses provide `state`,
+    `kernels.lookup_accounts/lookup_transfers`, and optionally `pad_to`."""
+
+    pad_to: int | None = None
+    prepare_timestamp: int = 0
+
+    def prepare(self, operation: Operation, event_count: int) -> None:
+        if operation in (Operation.create_accounts, Operation.create_transfers):
+            self.prepare_timestamp += event_count
+
+    def _pad_for(self, n: int) -> int:
+        return self.pad_to if self.pad_to is not None else _next_pow2(n)
+
+    def _lookup(self, kernel, ids: list[int]):
+        n_pad = self._pad_for(len(ids))
+        found, rows, resolved = kernel(self.state, ids_to_batch(ids, n_pad))
+        if not np.asarray(resolved).all():  # scalar (device) or per-lane (mesh)
+            raise RuntimeError("lookup probe-window overflow: grow the table")
+        found = np.asarray(found)[: len(ids)]
+        rows = np.asarray(rows)[: len(ids)]
+        return found, rows
+
+    def lookup_accounts(self, ids: list[int]) -> list[types.Account]:
+        found, rows = self._lookup(self.kernels.lookup_accounts, ids)
+        arr = np.frombuffer(rows.tobytes(), dtype=types.ACCOUNT_DTYPE)
+        return [types.Account.from_np(arr[i]) for i in range(len(ids)) if found[i]]
+
+    def lookup_transfers(self, ids: list[int]) -> list[types.Transfer]:
+        found, rows = self._lookup(self.kernels.lookup_transfers, ids)
+        arr = np.frombuffer(rows.tobytes(), dtype=types.TRANSFER_DTYPE)
+        return [types.Transfer.from_np(arr[i]) for i in range(len(ids)) if found[i]]
+
+
+def applied_insert_mask(dense: list[int], flags: np.ndarray) -> np.ndarray:
+    """Which events inserted a row at their turn — INCLUDING inserts later
+    rolled back by a chain break (rollback tombstones the slot, and
+    tombstones still extend probe chains, so they count toward the non-empty
+    slot density that the probe-window math bounds; see the load guard).
+
+    Reconstructs the chain outcomes from the dense result codes: code 1
+    (linked_event_failed) is only ever assigned by chain relabel/skip, and a
+    broken chain reads [1, 1, .., breaker-code, 1, ..] — members strictly
+    before the breaker were applied then rolled back."""
+    n = len(dense)
+    mask = np.zeros(n, dtype=bool)
+    i = 0
+    while i < n:
+        if not (int(flags[i]) & 1):  # standalone event
+            mask[i] = dense[i] == 0
+            i += 1
+            continue
+        j = i  # chain: linked run + its first non-linked member (if any)
+        while j < n and (int(flags[j]) & 1):
+            j += 1
+        end = min(j + 1, n)
+        chain = dense[i:end]
+        breaker = next((k for k, c in enumerate(chain) if c not in (0, 1)), None)
+        if breaker is None:
+            for k, c in enumerate(chain):
+                mask[i + k] = c == 0
+        else:
+            mask[i : i + breaker] = True  # applied, then rolled back
+        i = end
+    return mask
 
 
 class PendingBatch:
@@ -1001,15 +1076,17 @@ class PendingBatch:
     prepare in the reference's pipeline (reference:
     src/vsr/replica.zig:5102-5186, pipeline_prepare_queue_max=8)."""
 
-    __slots__ = ("operation", "n", "results")
+    __slots__ = ("operation", "n", "results", "flags", "id_limbs")
 
-    def __init__(self, operation, n, results):
+    def __init__(self, operation, n, results, flags=None, id_limbs=None):
         self.operation = operation
         self.n = n
         self.results = results  # device u32 [n_pad]
+        self.flags = flags  # host u16 [n] (occupancy reconciliation)
+        self.id_limbs = id_limbs  # host (lo, hi) u64 [n] (sharded reconcile)
 
 
-class DeviceLedger:
+class DeviceLedger(HostLedgerBase):
     """Host wrapper: owns the device state and mirrors the oracle's execute()
     API so the two are drop-in interchangeable in parity tests and in the VSR
     commit path (reference lifecycle: src/state_machine.zig:336-540
@@ -1047,13 +1124,6 @@ class DeviceLedger:
         self._xfer_limit = (1 << process.transfer_slots_log2) // 2
         self.hazards = HazardTracker()
 
-    def prepare(self, operation: Operation, event_count: int) -> None:
-        if operation in (Operation.create_accounts, Operation.create_transfers):
-            self.prepare_timestamp += event_count
-
-    def _pad_for(self, n: int) -> int:
-        return self.pad_to if self.pad_to is not None else _next_pow2(n)
-
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
@@ -1065,7 +1135,13 @@ class DeviceLedger:
     def execute_async(self, operation, timestamp: int, events) -> PendingBatch:
         """Dispatch a commit without any device->host synchronization.
         The caller materializes results later (results stay on device) and
-        MUST call check_fault() at least once after the last drain."""
+        MUST call check_fault() at least once after the last drain.
+
+        The occupancy guard charges the batch conservatively (+n, an upper
+        bound on inserted rows); calling drain() reconciles it to the exact
+        ever-applied count. An async driver that never drains keeps the
+        conservative estimate — safe (guard can only fire early, never
+        late)."""
         n = len(events)
         n_pad = self._pad_for(n)
         assert n <= n_pad
@@ -1086,7 +1162,7 @@ class DeviceLedger:
             self.state, results = self.kernels.commit_transfers(
                 self.state, batch, nn, ts, mode=mode
             )
-            self._xfer_used += n  # upper bound; exact count reconciled on drain
+            self._xfer_used += n
         elif operation == Operation.create_accounts:
             if self._acct_used + n > self._acct_limit:
                 raise RuntimeError(
@@ -1106,44 +1182,31 @@ class DeviceLedger:
             self._acct_used += n
         else:
             raise AssertionError(operation)
-        return PendingBatch(operation, n, results)
+        return PendingBatch(
+            operation, n, results, flags=arr["flags"].copy()
+        )
 
     def check_fault(self) -> None:
         """Raise if the device hit the fault protocol (see module docstring).
         Synchronizes with the device — amortize on the hot path."""
         raise_on_fault(int(np.asarray(self.state["fault"])), "device ledger")
 
-    def execute_dense(self, operation, timestamp: int, events) -> list[int]:
-        pending = self.execute_async(operation, timestamp, events)
+    def drain(self, pending: PendingBatch) -> list[int]:
+        """Materialize a pending batch's dense result codes; reconciles the
+        conservative occupancy charge to the exact ever-applied insert count
+        (rolled-back inserts leave tombstones, which still occupy probe
+        slots — see applied_insert_mask)."""
         dense = [int(x) for x in np.asarray(pending.results)[: pending.n]]
         self.check_fault()
-        # Reconcile the conservative load estimate with the exact ok-count.
-        fail_n = sum(1 for c in dense if c != 0)
-        if operation == Operation.create_transfers:
-            self._xfer_used -= fail_n
+        applied = int(applied_insert_mask(dense, pending.flags).sum())
+        if pending.operation == Operation.create_transfers:
+            self._xfer_used += applied - pending.n
         else:
-            self._acct_used -= fail_n
+            self._acct_used += applied - pending.n
         return dense
 
-    def _lookup(self, kernel, ids: list[int]):
-        n_pad = self._pad_for(len(ids))
-        found, rows, resolved = kernel(self.state, ids_to_batch(ids, n_pad))
-        if not bool(resolved):
-            raise RuntimeError("lookup probe-window overflow: grow the table")
-        found = np.asarray(found)[: len(ids)]
-        rows = np.asarray(rows)[: len(ids)]
-        return found, rows
-
-    def lookup_accounts(self, ids: list[int]) -> list[types.Account]:
-        found, rows = self._lookup(self.kernels.lookup_accounts, ids)
-        structured = rows.tobytes()
-        arr = np.frombuffer(structured, dtype=types.ACCOUNT_DTYPE)
-        return [types.Account.from_np(arr[i]) for i in range(len(ids)) if found[i]]
-
-    def lookup_transfers(self, ids: list[int]) -> list[types.Transfer]:
-        found, rows = self._lookup(self.kernels.lookup_transfers, ids)
-        arr = np.frombuffer(rows.tobytes(), dtype=types.TRANSFER_DTYPE)
-        return [types.Transfer.from_np(arr[i]) for i in range(len(ids)) if found[i]]
+    def execute_dense(self, operation, timestamp: int, events) -> list[int]:
+        return self.drain(self.execute_async(operation, timestamp, events))
 
     # -- parity extraction --
 

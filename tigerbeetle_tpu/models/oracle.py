@@ -14,6 +14,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterable
 
+import numpy as np
+
 from tigerbeetle_tpu.constants import NS_PER_S, U64_MAX, U128_MAX
 from tigerbeetle_tpu.types import (
     Account,
@@ -127,6 +129,10 @@ class OracleStateMachine:
         """Returns the sparse (index, result) list, exactly as the reference
         emits it (only non-ok results; chain rollbacks appended in FIFO order).
         """
+        if isinstance(events, np.ndarray):  # wire rows -> record classes
+            cls = Account if operation == Operation.create_accounts else Transfer
+            events = [cls.from_np(events[i]) for i in range(len(events))]
+
         results: list[tuple[int, int]] = []
         chain: int | None = None
         chain_broken = False

@@ -72,6 +72,7 @@ from tigerbeetle_tpu.models.ledger import (
     _next_pow2,
     _set_ts_words,
     HazardTracker,
+    HostLedgerBase,
     accounts_to_batch,
     key4_from_fields,
     pack_account,
@@ -89,7 +90,14 @@ U64 = jnp.uint64
 U32 = jnp.uint32
 I32 = jnp.int32
 
-_OWNER_MIX = np.uint64(0xD6E8FEB86659FD93)  # numpy: see ops/hashtable.py note
+# Owner-hash constants — the SINGLE source of truth for both the device hash
+# (owner_of_key4) and its host mirror (owner_of_ids_np); a parity test ties
+# the two (tests/test_mesh.py). numpy scalars: see ops/hashtable.py note.
+_OWNER_MIX = np.uint64(0xD6E8FEB86659FD93)
+_OWNER_XOR = np.uint64(0xA5A5A5A5A5A5A5A5)
+_OWNER_MUL2 = np.uint64(0x94D049BB133111EB)
+_OWNER_SHIFT1 = 29
+_OWNER_SHIFT2 = 32
 
 
 def owner_of_key4(key4, n_shards: int):
@@ -97,23 +105,23 @@ def owner_of_key4(key4, n_shards: int):
     k = key4.astype(U64)
     lo = k[..., 0] | (k[..., 1] << jnp.uint64(32))
     hi = k[..., 2] | (k[..., 3] << jnp.uint64(32))
-    x = (lo ^ jnp.uint64(0xA5A5A5A5A5A5A5A5)) * _OWNER_MIX
-    x = x ^ (hi * _OWNER_MIX) ^ (x >> jnp.uint64(29))
-    x = x * jnp.uint64(0x94D049BB133111EB)
-    x = x ^ (x >> jnp.uint64(32))
+    x = (lo ^ _OWNER_XOR) * _OWNER_MIX
+    x = x ^ (hi * _OWNER_MIX) ^ (x >> jnp.uint64(_OWNER_SHIFT1))
+    x = x * _OWNER_MUL2
+    x = x ^ (x >> jnp.uint64(_OWNER_SHIFT2))
     return (x % jnp.uint64(n_shards)).astype(I32)
 
 
 def owner_of_ids_np(id_lo: np.ndarray, id_hi: np.ndarray, n_shards: int) -> np.ndarray:
-    """Host-side mirror of owner_of_key4 (for the per-shard occupancy guard)."""
+    """Host-side mirror of owner_of_key4 (for the per-shard occupancy guard).
+    Same constants by construction; parity-tested against the device hash."""
     lo = id_lo.astype(np.uint64)
     hi = id_hi.astype(np.uint64)
-    mix = np.uint64(0xD6E8FEB86659FD93)
     with np.errstate(over="ignore"):
-        x = (lo ^ np.uint64(0xA5A5A5A5A5A5A5A5)) * mix
-        x = x ^ (hi * mix) ^ (x >> np.uint64(29))
-        x = x * np.uint64(0x94D049BB133111EB)
-        x = x ^ (x >> np.uint64(32))
+        x = (lo ^ _OWNER_XOR) * _OWNER_MIX
+        x = x ^ (hi * _OWNER_MIX) ^ (x >> np.uint64(_OWNER_SHIFT1))
+        x = x * _OWNER_MUL2
+        x = x ^ (x >> np.uint64(_OWNER_SHIFT2))
     return (x % np.uint64(n_shards)).astype(np.int64)
 
 
@@ -821,9 +829,10 @@ class ShardedLedgerKernels:
         return found, row, res
 
 
-class ShardedLedger:
+class ShardedLedger(HostLedgerBase):
     """Host wrapper over the sharded kernels. Mirrors DeviceLedger's
-    execute() API; tier selection is the same host-side HazardTracker."""
+    execute() API (HostLedgerBase: prepare/lookups); tier selection is the
+    same host-side HazardTracker."""
 
     def __init__(self, mesh: Mesh, process: ConfigProcess, mode: str = "auto"):
         self.mesh = mesh
@@ -894,13 +903,15 @@ class ShardedLedger:
         )
         dense = [int(x) for x in np.asarray(results)[:n]]
         self.check_fault()
-        # Reconcile the conservative per-shard estimate with actual failures.
-        fail = np.asarray(
-            [i for i, c in enumerate(dense) if c != 0], dtype=np.int64
-        )
-        if len(fail):
+        # Reconcile the conservative per-shard estimate to the exact
+        # ever-applied count (rolled-back inserts tombstone their slot on the
+        # owner shard and still occupy it — see models.ledger.applied_insert_mask).
+        from tigerbeetle_tpu.models.ledger import applied_insert_mask
+
+        not_applied = ~applied_insert_mask(dense, arr["flags"])
+        if not_applied.any():
             owners = owner_of_ids_np(
-                arr["id_lo"][fail], arr["id_hi"][fail], self.n_shards
+                arr["id_lo"][not_applied], arr["id_hi"][not_applied], self.n_shards
             )
             dec = np.bincount(owners, minlength=self.n_shards)
             if operation == Operation.create_transfers:
@@ -912,30 +923,7 @@ class ShardedLedger:
     def check_fault(self) -> None:
         raise_on_fault(int(np.asarray(self.state["fault"])), "sharded ledger")
 
-    # -- lookups & parity extraction (mirror DeviceLedger's API) --
-
-    def _lookup(self, kernel, ids: list[int]):
-        from tigerbeetle_tpu.models.ledger import ids_to_batch
-
-        n_pad = _next_pow2(len(ids))
-        found, rows, resolved = kernel(self.state, ids_to_batch(ids, n_pad))
-        if not np.asarray(resolved)[: len(ids)].all():
-            raise RuntimeError("lookup probe-window overflow: grow the table")
-        return np.asarray(found)[: len(ids)], np.asarray(rows)[: len(ids)]
-
-    def lookup_accounts(self, ids: list[int]):
-        from tigerbeetle_tpu import types as t
-
-        found, rows = self._lookup(self.kernels.lookup_accounts, ids)
-        arr = np.frombuffer(rows.tobytes(), dtype=t.ACCOUNT_DTYPE)
-        return [t.Account.from_np(arr[i]) for i in range(len(ids)) if found[i]]
-
-    def lookup_transfers(self, ids: list[int]):
-        from tigerbeetle_tpu import types as t
-
-        found, rows = self._lookup(self.kernels.lookup_transfers, ids)
-        arr = np.frombuffer(rows.tobytes(), dtype=t.TRANSFER_DTYPE)
-        return [t.Transfer.from_np(arr[i]) for i in range(len(ids)) if found[i]]
+    # -- parity extraction (lookups come from HostLedgerBase) --
 
     def extract(self):
         """Pull the full sharded state to host dicts (accounts, transfers,

@@ -1,0 +1,177 @@
+"""StateMachine: the wire-facing execution interface the VSR layer drives.
+
+This is the TPU build's analog of the reference's StateMachine lifecycle
+(reference: src/state_machine.zig:336-540 prepare/commit and :208-214 the
+operation enum): one entry point accepts an operation (128-131) plus the
+prepare's body bytes, and returns the reply body bytes in the reference's
+wire encoding:
+
+- create_accounts / create_transfers: sparse ``{index: u32, result: u32}``
+  result structs, only non-ok entries, chain rollbacks in FIFO order
+  (reference: src/tigerbeetle.zig:231-249, src/state_machine.zig:612-698).
+- lookup_accounts / lookup_transfers: the found objects' 128-byte wire rows,
+  in request order, missing ids skipped (reference:
+  src/state_machine.zig:701-736).
+
+The backend is anything with the ledger driver API (execute_dense /
+lookup_*_rows / prepare): the single-chip DeviceLedger, the multi-chip
+ShardedLedger, or the scalar OracleStateMachine — so VSR, the REPL, and the
+client server all run unchanged on any of them, and wire-level parity tests
+can diff backends byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import ConfigCluster, DEFAULT_CLUSTER
+from tigerbeetle_tpu.types import (
+    ACCOUNT_DTYPE,
+    CREATE_ACCOUNTS_RESULT_DTYPE,
+    CREATE_TRANSFERS_RESULT_DTYPE,
+    TRANSFER_DTYPE,
+    Operation,
+)
+
+ID_SIZE = 16  # lookup request: packed little-endian u128 ids
+EVENT_SIZE = 128
+RESULT_SIZE = 8
+
+_EVENT_DTYPES = {
+    Operation.create_accounts: ACCOUNT_DTYPE,
+    Operation.create_transfers: TRANSFER_DTYPE,
+}
+_RESULT_DTYPES = {
+    Operation.create_accounts: CREATE_ACCOUNTS_RESULT_DTYPE,
+    Operation.create_transfers: CREATE_TRANSFERS_RESULT_DTYPE,
+}
+
+
+def encode_results(sparse: list[tuple[int, int]], operation: Operation) -> bytes:
+    """Sparse (index, result) pairs -> reply body bytes (reference:
+    src/tigerbeetle.zig:231-249)."""
+    out = np.zeros(len(sparse), dtype=_RESULT_DTYPES[operation])
+    for i, (index, result) in enumerate(sparse):
+        out[i]["index"] = index
+        out[i]["result"] = result
+    return out.tobytes()
+
+
+def decode_results(body: bytes, operation: Operation) -> list[tuple[int, int]]:
+    assert len(body) % RESULT_SIZE == 0, len(body)
+    arr = np.frombuffer(body, dtype=_RESULT_DTYPES[operation])
+    return [(int(r["index"]), int(r["result"])) for r in arr]
+
+
+def encode_ids(ids: list[int]) -> bytes:
+    out = np.zeros(2 * len(ids), dtype=np.uint64)
+    for i, x in enumerate(ids):
+        lo, hi = types.split_u128(x)
+        out[2 * i] = lo
+        out[2 * i + 1] = hi
+    return out.tobytes()
+
+
+def decode_ids(body: bytes) -> list[int]:
+    assert len(body) % ID_SIZE == 0, len(body)
+    arr = np.frombuffer(body, dtype=np.uint64)
+    return [types.join_u128(arr[2 * i], arr[2 * i + 1]) for i in range(len(arr) // 2)]
+
+
+def decode_accounts(body: bytes) -> np.ndarray:
+    assert len(body) % EVENT_SIZE == 0, len(body)
+    return np.frombuffer(body, dtype=ACCOUNT_DTYPE).copy()
+
+
+def decode_transfers(body: bytes) -> np.ndarray:
+    assert len(body) % EVENT_SIZE == 0, len(body)
+    return np.frombuffer(body, dtype=TRANSFER_DTYPE).copy()
+
+
+class StateMachine:
+    """Drives a ledger backend with wire-format bodies.
+
+    Lifecycle mirrors the reference (src/state_machine.zig:336-540):
+      count = sm.input_count(op, body)   # body validation / batch sizing
+      sm.prepare(op, count)              # advances prepare_timestamp
+      reply = sm.commit(op, timestamp, body)
+    """
+
+    def __init__(self, backend, cluster: ConfigCluster = DEFAULT_CLUSTER):
+        self.backend = backend
+        self.cluster = cluster
+
+    # -- body validation & batch sizing --
+
+    def batch_max(self, operation: Operation) -> int:
+        """Per-op batch max = body_size_max / max(event_size, result_size)
+        (reference: src/state_machine.zig:59-64 operation_batch_max) — the
+        REPLY must fit in one message too, which is what bounds lookups
+        (16-byte id events but 128-byte object results)."""
+        body_max = self.cluster.message_size_max - 128  # header
+        event = EVENT_SIZE if operation in _EVENT_DTYPES else ID_SIZE
+        result = RESULT_SIZE if operation in _EVENT_DTYPES else EVENT_SIZE
+        return body_max // max(event, result)
+
+    def input_valid(self, operation: Operation, body: bytes) -> bool:
+        if operation in _EVENT_DTYPES:
+            event_size = EVENT_SIZE
+        elif operation in (Operation.lookup_accounts, Operation.lookup_transfers):
+            event_size = ID_SIZE
+        else:
+            return False
+        if len(body) == 0 or len(body) % event_size != 0:
+            return False
+        return len(body) // event_size <= self.batch_max(operation)
+
+    def input_count(self, operation: Operation, body: bytes) -> int:
+        assert self.input_valid(operation, body)
+        size = (
+            EVENT_SIZE
+            if operation in _EVENT_DTYPES
+            else ID_SIZE
+        )
+        return len(body) // size
+
+    def prepare(self, operation: Operation, body: bytes) -> None:
+        self.backend.prepare(operation, self.input_count(operation, body))
+
+    @property
+    def prepare_timestamp(self) -> int:
+        return self.backend.prepare_timestamp
+
+    @prepare_timestamp.setter
+    def prepare_timestamp(self, value: int) -> None:
+        self.backend.prepare_timestamp = value
+
+    # -- commit: wire body in, wire reply out --
+
+    def commit(self, operation: Operation, timestamp: int, body: bytes) -> bytes:
+        if operation == Operation.create_accounts:
+            events = decode_accounts(body)
+            dense = self.backend.execute_dense(operation, timestamp, events)
+            return encode_results(
+                [(i, c) for i, c in enumerate(dense) if c], operation
+            )
+        if operation == Operation.create_transfers:
+            events = decode_transfers(body)
+            dense = self.backend.execute_dense(operation, timestamp, events)
+            return encode_results(
+                [(i, c) for i, c in enumerate(dense) if c], operation
+            )
+        if operation == Operation.lookup_accounts:
+            return self._lookup_rows(decode_ids(body), accounts=True)
+        if operation == Operation.lookup_transfers:
+            return self._lookup_rows(decode_ids(body), accounts=False)
+        raise AssertionError(operation)
+
+    def _lookup_rows(self, ids: list[int], accounts: bool) -> bytes:
+        found = (
+            self.backend.lookup_accounts(ids)
+            if accounts
+            else self.backend.lookup_transfers(ids)
+        )
+        if accounts:
+            return types.accounts_to_np(found).tobytes()
+        return types.transfers_to_np(found).tobytes()
