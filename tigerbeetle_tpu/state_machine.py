@@ -14,7 +14,8 @@ wire encoding:
   src/state_machine.zig:701-736).
 
 The backend is anything with the ledger driver API (execute_dense /
-lookup_*_rows / prepare): the single-chip DeviceLedger, the multi-chip
+prepare / lookup_* — device backends also expose lookup_rows, the
+zero-copy reply path): the single-chip DeviceLedger, the multi-chip
 ShardedLedger, or the scalar OracleStateMachine — so VSR, the REPL, and the
 client server all run unchanged on any of them, and wire-level parity tests
 can diff backends byte-for-byte.
@@ -160,18 +161,16 @@ class StateMachine:
             return encode_results(
                 [(i, c) for i, c in enumerate(dense) if c], operation
             )
-        if operation == Operation.lookup_accounts:
-            return self._lookup_rows(decode_ids(body), accounts=True)
-        if operation == Operation.lookup_transfers:
-            return self._lookup_rows(decode_ids(body), accounts=False)
+        if operation in (Operation.lookup_accounts, Operation.lookup_transfers):
+            ids = decode_ids(body)
+            if hasattr(self.backend, "lookup_rows"):  # device backends:
+                return self.backend.lookup_rows(operation, ids)  # raw wire rows
+            found = (
+                self.backend.lookup_accounts(ids)
+                if operation == Operation.lookup_accounts
+                else self.backend.lookup_transfers(ids)
+            )
+            if operation == Operation.lookup_accounts:
+                return types.accounts_to_np(found).tobytes()
+            return types.transfers_to_np(found).tobytes()
         raise AssertionError(operation)
-
-    def _lookup_rows(self, ids: list[int], accounts: bool) -> bytes:
-        found = (
-            self.backend.lookup_accounts(ids)
-            if accounts
-            else self.backend.lookup_transfers(ids)
-        )
-        if accounts:
-            return types.accounts_to_np(found).tobytes()
-        return types.transfers_to_np(found).tobytes()
