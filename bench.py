@@ -282,10 +282,12 @@ def main() -> None:
             )
             rows = state["acct_rows"][slot]
             a = unpack_account(rows)
-            w = found & (jnp.arange(rows.shape[0]) < N_ACCOUNTS)
+            real = jnp.arange(rows.shape[0]) < N_ACCOUNTS
+            w = found & real
             dpo = jnp.sum(jnp.where(w, a["dpo_lo"], jnp.uint64(0)))
             cpo = jnp.sum(jnp.where(w, a["cpo_lo"], jnp.uint64(0)))
-            return dpo, cpo, jnp.sum(w.astype(jnp.int32)), jnp.all(res)
+            # resolve gated on REQUESTED lanes only (padding probes key 0)
+            return dpo, cpo, jnp.sum(w.astype(jnp.int32)), jnp.all(res | ~real)
 
         dpo, cpo, nfound, resolved = jax.jit(conservation)(ledger.state, ids)
         assert bool(np.asarray(resolved)), "verify lookup probe-window overflow"

@@ -1,0 +1,117 @@
+// Durable sector-aligned file IO — the storage layer under the WAL,
+// superblock, and grid zones.
+//
+// TPU-native counterpart of the reference's Storage (reference:
+// src/storage.zig:14-60): O_DIRECT + O_DSYNC where the filesystem supports
+// it (bypassing the page cache so an fsync'd write is really on the device),
+// with a buffered+fdatasync fallback otherwise. All IO is bounce-buffered
+// through a sector-aligned scratch so callers may pass arbitrary pointers.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t SECTOR = 4096;
+
+struct Bounce {
+  uint8_t *buf = nullptr;
+  size_t cap = 0;
+  ~Bounce() { free(buf); }
+  uint8_t *get(size_t need) {
+    if (cap < need) {
+      free(buf);
+      if (posix_memalign((void **)&buf, SECTOR, need) != 0) {
+        buf = nullptr;
+        cap = 0;
+        return nullptr;
+      }
+      cap = need;
+    }
+    return buf;
+  }
+};
+
+thread_local Bounce bounce;
+
+inline uint64_t round_up(uint64_t x, uint64_t m) { return (x + m - 1) / m * m; }
+
+}  // namespace
+
+extern "C" {
+
+// Open (or create) a data file of exactly `size` bytes. Tries O_DIRECT
+// first; falls back to buffered IO (some filesystems, e.g. overlayfs/tmpfs,
+// reject O_DIRECT). Returns fd >= 0, or -errno.
+int tb_storage_open(const char *path, uint64_t size, int must_create) {
+  int flags = O_RDWR | O_DSYNC | (must_create ? (O_CREAT | O_EXCL) : 0);
+  int fd = open(path, flags | O_DIRECT, 0644);
+  if (fd < 0 && (errno == EINVAL || errno == EOPNOTSUPP)) {
+    fd = open(path, flags, 0644);
+  }
+  if (fd < 0) return -errno;
+  if (must_create) {
+    if (ftruncate(fd, (off_t)size) != 0) {
+      int e = errno;
+      close(fd);
+      return -e;
+    }
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < size) {
+      close(fd);
+      return -EINVAL;
+    }
+  }
+  return fd;
+}
+
+int tb_storage_close(int fd) { return close(fd) == 0 ? 0 : -errno; }
+
+// Write `len` bytes at `offset` (both sector-multiples for the direct path;
+// the bounce buffer provides memory alignment). Returns 0 or -errno.
+int tb_storage_write(int fd, uint64_t offset, const void *data, uint64_t len) {
+  uint64_t need = round_up(len, SECTOR);
+  uint8_t *b = bounce.get(need);
+  if (!b) return -ENOMEM;
+  memcpy(b, data, len);
+  if (need > len) memset(b + len, 0, need - len);
+  uint64_t done = 0;
+  while (done < need) {
+    ssize_t n = pwrite(fd, b + done, need - done, (off_t)(offset + done));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    done += (uint64_t)n;
+  }
+  return 0;
+}
+
+int tb_storage_read(int fd, uint64_t offset, void *data, uint64_t len) {
+  uint64_t need = round_up(len, SECTOR);
+  uint8_t *b = bounce.get(need);
+  if (!b) return -ENOMEM;
+  uint64_t done = 0;
+  while (done < need) {
+    ssize_t n = pread(fd, b + done, need - done, (off_t)(offset + done));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (n == 0) break;  // short file tail reads as zeros
+    done += (uint64_t)n;
+  }
+  if (done < need) memset(b + done, 0, need - done);
+  memcpy(data, b, len);
+  return 0;
+}
+
+int tb_storage_sync(int fd) { return fdatasync(fd) == 0 ? 0 : -errno; }
+
+}  // extern "C"

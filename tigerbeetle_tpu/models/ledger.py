@@ -883,11 +883,14 @@ class LedgerKernels:
 
     def _lookup_accounts(self, state, ids):
         slot, found, res = ht.lookup(ids["key4"], state["acct_rows"], self.a_log2)
-        return found, state["acct_rows"][slot], jnp.all(res)
+        # Per-lane resolve (NOT jnp.all): the padding lanes probe key 0,
+        # whose single fixed window can fill with tombstones over time —
+        # only the caller knows which lanes were requested.
+        return found, state["acct_rows"][slot], res
 
     def _lookup_transfers(self, state, ids):
         slot, found, res = ht.lookup(ids["key4"], state["xfer_rows"], self.t_log2)
-        return found, state["xfer_rows"][slot], jnp.all(res)
+        return found, state["xfer_rows"][slot], res
 
 
 # ----------------------------------------------------------------------
@@ -1218,13 +1221,15 @@ class DeviceLedger(HostLedgerBase):
         if pending.dense is not None:
             return pending.dense
         dense = [int(x) for x in np.asarray(pending.results)[: pending.n]]
-        pending.dense = dense
         self.check_fault()
         applied = int(applied_insert_mask(dense, pending.flags).sum())
         if pending.operation == Operation.create_transfers:
             self._xfer_used += applied - pending.n
         else:
             self._acct_used += applied - pending.n
+        # Cache only AFTER the fault check and reconcile: a drain retried
+        # after a fault exception must re-raise, not return unsound codes.
+        pending.dense = dense
         return dense
 
     def execute_dense(self, operation, timestamp: int, events) -> list[int]:

@@ -1,0 +1,72 @@
+"""ctypes binding to the native C++ runtime library (native/libtb_native.so).
+
+The compute path is JAX/XLA; the runtime around it — checksums, durable
+sector IO — is native C++ (the reference's analogs are Zig:
+src/vsr/checksum.zig, src/storage.zig). The library is built on demand with
+the baked-in g++ (no pip/pybind11 — plain ctypes over a C ABI).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtb_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def _build() -> None:
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in ("aegis.cc", "storage.cc")]
+    if os.path.exists(_LIB_PATH) and all(
+        os.path.getmtime(_LIB_PATH) >= os.path.getmtime(s) for s in srcs
+    ):
+        return
+    subprocess.run(
+        ["make", "-s", "libtb_native.so"], cwd=_NATIVE_DIR, check=True
+    )
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            _build()
+            l = ctypes.CDLL(_LIB_PATH)
+            l.tb_checksum.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p
+            ]
+            l.tb_checksum.restype = None
+            l.tb_storage_open.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int
+            ]
+            l.tb_storage_open.restype = ctypes.c_int
+            l.tb_storage_close.argtypes = [ctypes.c_int]
+            l.tb_storage_close.restype = ctypes.c_int
+            for fn in (l.tb_storage_write, l.tb_storage_read):
+                fn.argtypes = [
+                    ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p,
+                    ctypes.c_uint64,
+                ]
+                fn.restype = ctypes.c_int
+            l.tb_storage_sync.argtypes = [ctypes.c_int]
+            l.tb_storage_sync.restype = ctypes.c_int
+            _lib = l
+    return _lib
+
+
+def checksum(data: bytes) -> int:
+    """AEGIS-128L MAC checksum -> u128 (reference: src/vsr/checksum.zig:53).
+    Every header, body, and block is guarded by this."""
+    out = ctypes.create_string_buffer(16)
+    lib().tb_checksum(bytes(data), len(data), out)
+    return int.from_bytes(out.raw, "little")
+
+
+CHECKSUM_BODY_EMPTY = 0x49F174618255402DE6E7E3C40D60CC83
+"""checksum(b"") — pinned by the reference (src/vsr.zig:238)."""

@@ -1,0 +1,149 @@
+"""The 128-byte VSR Header — shared by network messages and journal entries.
+
+Field-for-field the reference's wire layout (reference: src/vsr.zig:235-394:
+checksum u128, checksum_body u128, parent u128, client u128, context u128,
+request u32, cluster u32, epoch u32, view u32, op u64, commit u64,
+timestamp u64, size u32, replica u8, command u8, operation u8, version u8 —
+little-endian extern struct, no padding). The dual checksums let a header be
+trusted without reading its body, and `parent` hash-chains prepares
+(reference: src/vsr.zig:246-268).
+
+Checksums are the native AEGIS-128L MAC (tigerbeetle_tpu.native), identical
+construction to the reference's vsr.checksum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+from tigerbeetle_tpu import native
+from tigerbeetle_tpu.types import Operation, join_u128, split_u128
+
+HEADER_SIZE = 128
+VERSION = 0
+
+
+class Command(enum.IntEnum):
+    """VSR protocol commands (reference: src/vsr.zig:111-154)."""
+
+    reserved = 0
+    ping = 1
+    pong = 2
+    ping_client = 3
+    pong_client = 4
+    request = 5
+    prepare = 6
+    prepare_ok = 7
+    reply = 8
+    commit = 9
+    start_view_change = 10
+    do_view_change = 11
+    start_view = 12
+    request_start_view = 13
+    request_headers = 14
+    request_prepare = 15
+    request_reply = 16
+    headers = 17
+    eviction = 18
+    request_blocks = 19
+    block = 20
+    request_sync_manifest = 21
+    request_sync_free_set = 22
+    request_sync_client_sessions = 23
+    sync_manifest = 24
+    sync_free_set = 25
+    sync_client_sessions = 26
+
+
+HEADER_DTYPE = np.dtype(
+    [
+        ("checksum_lo", "<u8"), ("checksum_hi", "<u8"),
+        ("checksum_body_lo", "<u8"), ("checksum_body_hi", "<u8"),
+        ("parent_lo", "<u8"), ("parent_hi", "<u8"),
+        ("client_lo", "<u8"), ("client_hi", "<u8"),
+        ("context_lo", "<u8"), ("context_hi", "<u8"),
+        ("request", "<u4"),
+        ("cluster", "<u4"),
+        ("epoch", "<u4"),
+        ("view", "<u4"),
+        ("op", "<u8"),
+        ("commit", "<u8"),
+        ("timestamp", "<u8"),
+        ("size", "<u4"),
+        ("replica", "u1"),
+        ("command", "u1"),
+        ("operation", "u1"),
+        ("version", "u1"),
+    ]
+)
+assert HEADER_DTYPE.itemsize == HEADER_SIZE
+
+
+@dataclasses.dataclass
+class Header:
+    checksum: int = 0
+    checksum_body: int = 0
+    parent: int = 0
+    client: int = 0
+    context: int = 0
+    request: int = 0
+    cluster: int = 0
+    epoch: int = 0
+    view: int = 0
+    op: int = 0
+    commit: int = 0
+    timestamp: int = 0
+    size: int = HEADER_SIZE
+    replica: int = 0
+    command: int = int(Command.reserved)
+    operation: int = int(Operation.reserved)
+    version: int = VERSION
+
+    # -- wire --
+
+    def to_bytes(self) -> bytes:
+        row = np.zeros(1, dtype=HEADER_DTYPE)[0]
+        for f in ("checksum", "checksum_body", "parent", "client", "context"):
+            lo, hi = split_u128(getattr(self, f))
+            row[f + "_lo"], row[f + "_hi"] = lo, hi
+        for f in ("request", "cluster", "epoch", "view", "op", "commit",
+                  "timestamp", "size", "replica", "command", "operation",
+                  "version"):
+            row[f] = getattr(self, f)
+        return row.tobytes()
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "Header":
+        assert len(b) == HEADER_SIZE, len(b)
+        row = np.frombuffer(b, dtype=HEADER_DTYPE)[0]
+        h = Header()
+        for f in ("checksum", "checksum_body", "parent", "client", "context"):
+            setattr(h, f, join_u128(row[f + "_lo"], row[f + "_hi"]))
+        for f in ("request", "cluster", "epoch", "view", "op", "commit",
+                  "timestamp", "size", "replica", "command", "operation",
+                  "version"):
+            setattr(h, f, int(row[f]))
+        return h
+
+    # -- checksums (reference: src/vsr.zig:428-442 set/valid pattern) --
+
+    def calculate_checksum(self) -> int:
+        """Checksum over the header bytes EXCLUDING the leading checksum
+        field itself."""
+        return native.checksum(self.to_bytes()[16:])
+
+    def set_checksum_body(self, body: bytes) -> None:
+        self.size = HEADER_SIZE + len(body)
+        self.checksum_body = native.checksum(body)
+
+    def set_checksum(self) -> None:
+        self.checksum = self.calculate_checksum()
+
+    def valid_checksum(self) -> bool:
+        return self.checksum == self.calculate_checksum()
+
+    def valid_checksum_body(self, body: bytes) -> bool:
+        return self.checksum_body == native.checksum(body)

@@ -1,0 +1,131 @@
+"""The write-ahead log: two on-disk circular rings over the Storage seam.
+
+The reference's journal design (reference: src/vsr/journal.zig:18-47): a
+`wal_prepares` ring of `journal_slot_count` message-sized slots holding the
+full prepare (header + body), plus a redundant `wal_headers` ring holding
+only the 128-byte headers. The redundant copy disambiguates torn writes: a
+torn PREPARE write leaves a valid redundant header pointing at a broken
+prepare (slot faulty, repairable); a torn HEADER write leaves a valid
+prepare whose own header wins (reference: src/vsr/journal.zig:374-535
+recovery decision matrix — the single-replica subset implemented here).
+
+Slot assignment: op % slot_count (ring). The checkpoint interval keeps a
+bar of headroom so un-checkpointed ops are never overwritten (reference:
+src/vsr.zig:2003-2035 checkpoint arithmetic).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tigerbeetle_tpu.constants import ConfigCluster
+from tigerbeetle_tpu.io.storage import SECTOR_SIZE, Storage, Zone
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
+
+
+class Journal:
+    def __init__(self, storage: Storage, cluster: ConfigCluster):
+        self.storage = storage
+        self.cluster = cluster
+        self.slot_count = cluster.journal_slot_count
+        self.msg_max = cluster.message_size_max
+        # In-memory mirror of the redundant header ring (so a slot's header
+        # write is a single-sector read-modify-write against this mirror).
+        self._headers = bytearray(self.slot_count * HEADER_SIZE)
+
+    def slot_for_op(self, op: int) -> int:
+        return op % self.slot_count
+
+    # -- write path --
+
+    def write_prepare(self, header: Header, body: bytes) -> None:
+        """Write prepare (header+body) to its slot, then the redundant
+        header — prepare FIRST, matching the reference's ordering so a torn
+        redundant-header write still recovers from the prepare ring
+        (reference: src/vsr/journal.zig write_prepare_header sequencing)."""
+        assert header.command == Command.prepare
+        assert header.size == HEADER_SIZE + len(body)
+        assert header.size <= self.msg_max
+        slot = self.slot_for_op(header.op)
+        self.storage.write(
+            Zone.wal_prepares, slot * self.msg_max, header.to_bytes() + body
+        )
+        self._write_header(slot, header)
+
+    def _write_header(self, slot: int, header: Header) -> None:
+        off = slot * HEADER_SIZE
+        self._headers[off : off + HEADER_SIZE] = header.to_bytes()
+        sector = off // SECTOR_SIZE * SECTOR_SIZE
+        self.storage.write(
+            Zone.wal_headers, sector,
+            bytes(self._headers[sector : sector + SECTOR_SIZE]),
+        )
+
+    # -- read path --
+
+    def read_prepare(self, op: int) -> tuple[Header, bytes] | None:
+        """The prepare for `op`, or None if the slot holds a different op or
+        fails its checksums."""
+        slot = self.slot_for_op(op)
+        raw = self.storage.read(Zone.wal_prepares, slot * self.msg_max, self.msg_max)
+        header = Header.from_bytes(raw[:HEADER_SIZE])
+        if not header.valid_checksum() or header.op != op:
+            return None
+        if header.command != Command.prepare:
+            return None
+        body = raw[HEADER_SIZE : header.size]
+        if not header.valid_checksum_body(body):
+            return None
+        return header, body
+
+    # -- recovery --
+
+    def recover(self) -> dict[int, Header]:
+        """Scan both rings; return op -> header for every slot whose prepare
+        is intact (the replayable set). Rebuilds the in-memory header mirror
+        from BOTH rings and records faulty slots.
+
+        Single-replica decision subset of the reference's matrix
+        (reference: src/vsr/journal.zig:374-535):
+        - prepare valid                      -> slot holds prepare.op
+        - prepare torn, redundant valid      -> FAULTY slot: the op's body
+          is lost; `faulty` records it (with replica_count=1 recovery stops
+          at the gap; the reference nacks/repairs it from peers). The
+          redundant header is kept in the mirror so neighbor-sector
+          read-modify-writes don't destroy the evidence.
+        - both torn/empty                    -> empty slot
+        """
+        out: dict[int, Header] = {}
+        self.faulty: dict[int, int] = {}  # slot -> op whose body is lost
+        raw_headers = self.storage.read(
+            Zone.wal_headers, 0,
+            (self.slot_count * HEADER_SIZE + SECTOR_SIZE - 1)
+            // SECTOR_SIZE * SECTOR_SIZE,
+        )
+        for slot in range(self.slot_count):
+            praw = self.storage.read(
+                Zone.wal_prepares, slot * self.msg_max, self.msg_max
+            )
+            p_header = Header.from_bytes(praw[:HEADER_SIZE])
+            p_ok = (
+                p_header.valid_checksum()
+                and p_header.command == Command.prepare
+                and self.slot_for_op(p_header.op) == slot
+                and p_header.size <= self.msg_max
+                and p_header.valid_checksum_body(praw[HEADER_SIZE : p_header.size])
+            )
+            off = slot * HEADER_SIZE
+            if p_ok:
+                out[p_header.op] = p_header
+                self._headers[off : off + HEADER_SIZE] = p_header.to_bytes()
+                continue
+            r_header = Header.from_bytes(raw_headers[off : off + HEADER_SIZE])
+            r_ok = (
+                r_header.valid_checksum()
+                and r_header.command == Command.prepare
+                and self.slot_for_op(r_header.op) == slot
+            )
+            if r_ok:  # torn prepare: op known, body lost
+                self.faulty[slot] = r_header.op
+                self._headers[off : off + HEADER_SIZE] = r_header.to_bytes()
+        return out
