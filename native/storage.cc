@@ -52,7 +52,11 @@ int tb_storage_open(const char *path, uint64_t size, int must_create) {
   int flags = O_RDWR | O_DSYNC | (must_create ? (O_CREAT | O_EXCL) : 0);
   int fd = open(path, flags | O_DIRECT, 0644);
   if (fd < 0 && (errno == EINVAL || errno == EOPNOTSUPP)) {
-    fd = open(path, flags, 0644);
+    // Some filesystems reject O_DIRECT only after creating the inode, so
+    // the buffered retry must not O_EXCL-fail on the file the failed open
+    // just created.
+    int retry_flags = O_RDWR | O_DSYNC | (must_create ? O_CREAT : 0);
+    fd = open(path, retry_flags, 0644);
   }
   if (fd < 0) return -errno;
   if (must_create) {

@@ -1,0 +1,76 @@
+"""The Network seam: message transport between replicas and clients.
+
+The reference's MessageBus is a TCP mesh in production and a virtual
+PacketSimulator under test, swapped at the same interface (reference:
+src/message_bus.zig:21-22 vs src/testing/cluster/network.zig). Same seam
+here: `Network.send(src, dst, data)` with delivery via registered handlers.
+
+Addresses: replicas are ints 0..n-1; clients are their u128 client ids.
+Messages are REAL wire bytes (128-byte Header + body) — everything crossing
+this seam would survive a socket.
+
+InProcessNetwork is the deterministic scripted transport (cluster tests):
+messages queue in send order and `step()`/`run()` pump them one at a time;
+`filters` may drop or hold messages (partitions, drops — the LinkFilter
+analog, reference: src/vsr/replica_test.zig scripted networks)."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable
+
+Address = int  # replica index (< 2^32) or client id (u128)
+Handler = Callable[[Address, bytes], None]
+Filter = Callable[[Address, Address, bytes], bool]  # True = deliver
+
+
+class Network:
+    def attach(self, addr: Address, handler: Handler) -> None:
+        raise NotImplementedError
+
+    def send(self, src: Address, dst: Address, data: bytes) -> None:
+        raise NotImplementedError
+
+
+class InProcessNetwork(Network):
+    def __init__(self):
+        self.handlers: dict[Address, Handler] = {}
+        self.queue: deque[tuple[Address, Address, bytes]] = deque()
+        self.filters: list[Filter] = []
+        self.delivered = 0
+        self.dropped = 0
+
+    def attach(self, addr: Address, handler: Handler) -> None:
+        self.handlers[addr] = handler
+
+    def send(self, src: Address, dst: Address, data: bytes) -> None:
+        self.queue.append((src, dst, bytes(data)))
+
+    # -- pumping --
+
+    def step(self) -> bool:
+        """Deliver one queued message (or drop it per filters). Returns
+        False when the queue is empty."""
+        if not self.queue:
+            return False
+        src, dst, data = self.queue.popleft()
+        for f in self.filters:
+            if not f(src, dst, data):
+                self.dropped += 1
+                return True
+        handler = self.handlers.get(dst)
+        if handler is None:
+            self.dropped += 1
+            return True
+        self.delivered += 1
+        handler(src, data)
+        return True
+
+    def run(self, limit: int = 100_000) -> int:
+        """Pump until quiescent. Returns messages processed."""
+        n = 0
+        while self.step():
+            n += 1
+            if n >= limit:
+                raise RuntimeError("network did not quiesce (livelock?)")
+        return n

@@ -1,0 +1,93 @@
+"""Deterministic in-process cluster: real replicas + clients over fakes.
+
+The reference's ClusterType (reference: src/testing/cluster.zig:50-73)
+wires production replicas to in-memory Storage, a virtual Network, and
+virtual Time with ZERO changes to the replica code — the comptime seams.
+This is the same harness over our seams, used by the cluster tests and the
+simulator.
+"""
+
+from __future__ import annotations
+
+from tigerbeetle_tpu.constants import ConfigCluster, ConfigProcess
+from tigerbeetle_tpu.io.network import InProcessNetwork
+from tigerbeetle_tpu.io.storage import MemoryStorage, ZoneLayout
+from tigerbeetle_tpu.io.time import DeterministicTime
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.client import Client
+from tigerbeetle_tpu.vsr.durable import format_data_file
+from tigerbeetle_tpu.vsr.header import Header
+from tigerbeetle_tpu.vsr.replica import Replica
+
+CLIENT_ID_BASE = 1 << 64  # client addresses: above any replica index
+
+
+class Cluster:
+    def __init__(
+        self,
+        replica_count: int = 3,
+        cluster: ConfigCluster | None = None,
+        process: ConfigProcess | None = None,
+        grid_size: int = 8 * 1024 * 1024,
+        mode: str = "auto",
+        backend_factory=None,
+        network: InProcessNetwork | None = None,
+        seed: int = 0,
+    ):
+        from tigerbeetle_tpu.constants import TEST_CLUSTER, TEST_PROCESS
+
+        self.cluster_config = cluster or TEST_CLUSTER
+        self.process_config = process or TEST_PROCESS
+        self.network = network if network is not None else InProcessNetwork()
+        self.time = DeterministicTime()
+        self.mode = mode
+        self.backend_factory = backend_factory
+        self.layout = ZoneLayout(self.cluster_config, grid_size=grid_size)
+        self.storages = []
+        self.replicas: list[Replica] = []
+        self.clients: list[Client] = []
+
+        for i in range(replica_count):
+            storage = MemoryStorage(self.layout, seed=seed * 97 + i)
+            format_data_file(storage, self.cluster_config)
+            self.storages.append(storage)
+            r = Replica(
+                i, replica_count, storage, self.network, self.time,
+                self.cluster_config, self.process_config, mode=mode,
+                backend_factory=backend_factory,
+            )
+            r.open()
+            self.replicas.append(r)
+
+    def add_client(self) -> Client:
+        c = Client(
+            CLIENT_ID_BASE + len(self.clients), self.network,
+            len(self.replicas),
+        )
+        self.clients.append(c)
+        c.register()
+        self.network.run()
+        c.take_reply()
+        assert c.session != 0
+        return c
+
+    def execute(self, client: Client, operation: Operation,
+                body: bytes) -> tuple[Header, bytes]:
+        """Send one request and pump the network until its reply arrives."""
+        client.request(operation, body)
+        self.network.run()
+        return client.take_reply()
+
+    def restart_replica(self, index: int, backend_factory=None) -> Replica:
+        """Crash-restart a replica over its surviving storage bytes."""
+        old = self.replicas[index]
+        r = Replica(
+            index, len(self.replicas), self.storages[index], self.network,
+            self.time, self.cluster_config, self.process_config,
+            mode=self.mode,
+            backend_factory=backend_factory or self.backend_factory,
+        )
+        r.open()
+        self.replicas[index] = r
+        del old
+        return r

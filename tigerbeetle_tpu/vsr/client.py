@@ -1,0 +1,98 @@
+"""The native session client (reference: src/vsr/client.zig:17-80).
+
+Protocol: register a session (an op committed through the cluster), then
+one in-flight request at a time, each with a monotonically increasing
+request number; the session number rides in `context` so the cluster can
+evict stale sessions; replies are matched by request number. Retries resend
+the SAME message bytes (idempotent via the replicated client table)."""
+
+from __future__ import annotations
+
+from tigerbeetle_tpu.io.network import Network
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
+
+
+class Client:
+    def __init__(self, client_id: int, network: Network, replica_count: int,
+                 cluster_id: int = 0):
+        self.client_id = client_id
+        self.network = network
+        self.replica_count = replica_count
+        self.cluster_id = cluster_id
+        self.session = 0
+        self.request_number = 0
+        self.view = 0  # best-known view (updates from replies)
+        self.reply: tuple[Header, bytes] | None = None
+        self.evicted = False
+        self.in_flight: bytes | None = None
+        network.attach(client_id, self._on_message)
+
+    @property
+    def primary_index(self) -> int:
+        return self.view % self.replica_count
+
+    def _on_message(self, src, data: bytes) -> None:
+        header = Header.from_bytes(data[:HEADER_SIZE])
+        if not header.valid_checksum():
+            return
+        body = data[HEADER_SIZE : header.size]
+        if not header.valid_checksum_body(body):
+            return
+        if header.command == Command.eviction:
+            self.evicted = True
+            return
+        if header.command != Command.reply:
+            return
+        if header.request != self.request_number:
+            return  # stale reply
+        self.view = max(self.view, header.view)
+        self.in_flight = None
+        self.reply = (header, body)
+
+    # -- requests (the pump is external: network.run()) --
+
+    def register(self) -> None:
+        assert self.session == 0 and self.in_flight is None
+        self.request_number = 0
+        h = Header(
+            command=int(Command.request),
+            operation=int(Operation.register),
+            client=self.client_id,
+            request=0,
+            cluster=self.cluster_id,
+        )
+        self._send(h, b"")
+
+    def request(self, operation: Operation, body: bytes) -> None:
+        assert self.session != 0, "register first"
+        assert self.in_flight is None, "one in-flight request per client"
+        self.request_number += 1
+        h = Header(
+            command=int(Command.request),
+            operation=int(operation),
+            client=self.client_id,
+            context=self.session,
+            request=self.request_number,
+            cluster=self.cluster_id,
+        )
+        self._send(h, body)
+
+    def _send(self, header: Header, body: bytes) -> None:
+        header.set_checksum_body(body)
+        header.set_checksum()
+        self.in_flight = header.to_bytes() + body
+        self.network.send(self.client_id, self.primary_index, self.in_flight)
+
+    def resend(self) -> None:
+        """Retry the in-flight request (timeout / view change)."""
+        assert self.in_flight is not None
+        self.network.send(self.client_id, self.primary_index, self.in_flight)
+
+    def take_reply(self) -> tuple[Header, bytes]:
+        assert self.reply is not None, "no reply pending"
+        header, body = self.reply
+        self.reply = None
+        if header.operation == int(Operation.register):
+            self.session = int.from_bytes(body[:8], "little")
+        return header, body

@@ -57,6 +57,100 @@ def format_data_file(storage: Storage, cluster: ConfigCluster = DEFAULT_CLUSTER,
     sb.checkpoint(VSRState(cluster=cluster_id, replica=replica, sequence=1))
 
 
+def snapshot_to_superblock(
+    storage: Storage,
+    ledger: DeviceLedger,
+    sm: StateMachine,
+    superblock: SuperBlock,
+    commit_min: int,
+    commit_min_checksum: int,
+    extra_meta: dict | None = None,
+) -> None:
+    """Checkpoint the ledger state: blobs to the grid zone (ping-ponged by
+    sequence parity), THEN the superblock records them — state first, mark
+    second (reference: src/vsr/replica.zig:3489-3561 ordering). Shared by
+    the single-replica DurableLedger and the VSR Replica."""
+    state = superblock.state
+    assert state is not None
+    sequence = state.sequence + 1
+    area_size = storage.layout.sizes[Zone.grid] // 2
+    base = (sequence % 2) * area_size
+
+    dev = ledger.state
+    blobs: list[BlobRef] = []
+    off = base
+    for name in SNAPSHOT_LEAVES:
+        data = np.asarray(dev[name]).tobytes()
+        assert off + len(data) <= base + area_size, "grid area overflow"
+        storage.write(Zone.grid, off, data)
+        blobs.append(BlobRef(name, off, len(data), native.checksum(data)))
+        off += (len(data) + 4095) // 4096 * 4096
+
+    h = ledger.hazards
+    meta = {
+        "counters": {k: int(np.asarray(dev[k])) for k in COUNTER_LEAVES},
+        "fault": int(np.asarray(dev["fault"])),
+        "acct_used": ledger._acct_used,
+        "xfer_used": ledger._xfer_used,
+        "amount_sum": str(h.amount_sum),  # may exceed u64: JSON as str
+        "limit_account_ids": [str(x) for x in sorted(h.limit_account_ids)],
+        **(extra_meta or {}),
+    }
+    assert meta["fault"] == 0, "refusing to checkpoint a faulted ledger"
+    storage.sync()  # blobs durable before the superblock points at them
+
+    superblock.checkpoint(VSRState(
+        cluster=state.cluster,
+        replica=state.replica,
+        sequence=sequence,
+        commit_min=commit_min,
+        commit_min_checksum=commit_min_checksum,
+        commit_max=commit_min,
+        prepare_timestamp=sm.prepare_timestamp,
+        blobs=blobs,
+        meta=meta,
+    ))
+
+
+def restore_from_snapshot(
+    storage: Storage,
+    ledger: DeviceLedger,
+    sm: StateMachine,
+    process: ConfigProcess,
+    state: VSRState,
+) -> None:
+    """Load a checkpoint back into the device ledger (inverse of
+    snapshot_to_superblock; fresh state when the superblock has no blobs)."""
+    import jax.numpy as jnp
+
+    dev = init_state(process)
+    if state.blobs:
+        for ref in state.blobs:
+            raw = storage.read(Zone.grid, ref.offset, ref.size)
+            if native.checksum(raw) != ref.checksum:
+                raise RuntimeError(f"snapshot blob {ref.name}: bad checksum")
+            host = np.frombuffer(raw, dtype=np.uint32).reshape(
+                np.asarray(dev[ref.name]).shape
+            )
+            dev[ref.name] = jnp.asarray(host)
+        counters = state.meta["counters"]
+        for k in COUNTER_LEAVES:
+            dev[k] = jnp.uint64(int(counters[k]))
+        ledger._acct_used = int(state.meta["acct_used"])
+        ledger._xfer_used = int(state.meta["xfer_used"])
+        h = ledger.hazards
+        h.amount_sum = int(state.meta["amount_sum"])
+        h.limit_account_ids = {int(x) for x in state.meta["limit_account_ids"]}
+        h._limit_lo = np.sort(
+            np.array(
+                [int(x) & ((1 << 64) - 1) for x in state.meta["limit_account_ids"]],
+                dtype=np.uint64,
+            )
+        )
+    ledger.state = dev
+    sm.prepare_timestamp = state.prepare_timestamp
+
+
 class DurableLedger:
     """The durable single-replica process around the device ledger."""
 
@@ -146,76 +240,13 @@ class DurableLedger:
     # ------------------------------------------------------------------
 
     def checkpoint(self) -> None:
-        state = self.superblock.state
-        assert state is not None
-        sequence = state.sequence + 1
-        # Ping-pong area by sequence parity: the previous checkpoint's blobs
-        # stay intact until the new superblock quorum lands.
-        area_size = self.storage.layout.sizes[Zone.grid] // 2
-        base = (sequence % 2) * area_size
-
-        dev = self.ledger.state
-        blobs: list[BlobRef] = []
-        off = base
-        for name in SNAPSHOT_LEAVES:
-            data = np.asarray(dev[name]).tobytes()
-            assert off + len(data) <= base + area_size, "grid area overflow"
-            self.storage.write(Zone.grid, off, data)
-            blobs.append(BlobRef(name, off, len(data), native.checksum(data)))
-            off += (len(data) + 4095) // 4096 * 4096
-
-        h = self.ledger.hazards
-        meta = {
-            "counters": {k: int(np.asarray(dev[k])) for k in COUNTER_LEAVES},
-            "fault": int(np.asarray(dev["fault"])),
-            "acct_used": self.ledger._acct_used,
-            "xfer_used": self.ledger._xfer_used,
-            "amount_sum": str(h.amount_sum),  # may exceed u64: JSON as str
-            "limit_account_ids": [str(x) for x in sorted(h.limit_account_ids)],
-        }
-        assert meta["fault"] == 0, "refusing to checkpoint a faulted ledger"
-        self.storage.sync()  # blobs durable before the superblock points at them
-
-        new_state = VSRState(
-            cluster=state.cluster,
-            replica=state.replica,
-            sequence=sequence,
-            commit_min=self.op,
-            commit_min_checksum=self.parent_checksum,
-            commit_max=self.op,
-            prepare_timestamp=self.sm.prepare_timestamp,
-            blobs=blobs,
-            meta=meta,
+        snapshot_to_superblock(
+            self.storage, self.ledger, self.sm, self.superblock,
+            commit_min=self.op, commit_min_checksum=self.parent_checksum,
         )
-        self.superblock.checkpoint(new_state)
         self.checkpoint_op = self.op
 
     def _restore_snapshot(self, state: VSRState) -> None:
-        import jax.numpy as jnp
-
-        dev = init_state(self.process)
-        if state.blobs:
-            for ref in state.blobs:
-                raw = self.storage.read(Zone.grid, ref.offset, ref.size)
-                if native.checksum(raw) != ref.checksum:
-                    raise RuntimeError(f"snapshot blob {ref.name}: bad checksum")
-                host = np.frombuffer(raw, dtype=np.uint32).reshape(
-                    np.asarray(dev[ref.name]).shape
-                )
-                dev[ref.name] = jnp.asarray(host)
-            counters = state.meta["counters"]
-            for k in COUNTER_LEAVES:
-                dev[k] = jnp.uint64(int(counters[k]))
-            self.ledger._acct_used = int(state.meta["acct_used"])
-            self.ledger._xfer_used = int(state.meta["xfer_used"])
-            h = self.ledger.hazards
-            h.amount_sum = int(state.meta["amount_sum"])
-            h.limit_account_ids = {int(x) for x in state.meta["limit_account_ids"]}
-            h._limit_lo = np.sort(
-                np.array(
-                    [int(x) & ((1 << 64) - 1) for x in state.meta["limit_account_ids"]],
-                    dtype=np.uint64,
-                )
-            )
-        self.ledger.state = dev
-        self.sm.prepare_timestamp = state.prepare_timestamp
+        restore_from_snapshot(
+            self.storage, self.ledger, self.sm, self.process, state
+        )

@@ -1,0 +1,395 @@
+"""The VSR replica: consensus-driven replication of the device ledger.
+
+Viewstamped Replication normal path (reference: src/vsr/replica.zig —
+on_request :1208, on_prepare :1262, on_prepare_ok :1346, on_commit :1485,
+commit dispatch :3045-3103):
+
+- The PRIMARY (view % replica_count) sequences client requests into
+  prepares: assigns op + batch-final timestamp, hash-chains the header to
+  its predecessor, journals it (WAL-before-ack), broadcasts to backups, and
+  counts prepare_oks (its own journal write included).
+- BACKUPS verify the chain, journal the prepare, and ack prepare_ok.
+- At a replication quorum (majority), the primary commits in op order
+  through the StateMachine (the TPU device ledger), replies to the client,
+  and advances commit_max; backups commit from their journal when the
+  commit number reaches them (piggybacked on prepares + commit heartbeats).
+- Client sessions are part of the replicated state: `register` ops flow
+  through the log and every replica's client table updates identically
+  (reference: src/vsr/replica.zig:3758-3860), so duplicate requests are
+  answered from the table without re-execution.
+
+View changes / repair / state sync land on top of this (reference
+:1595-1924); status tracks it. All transport is real wire bytes through
+the Network seam; all persistence through the Storage seam — so the
+deterministic cluster (testing/cluster.py) runs this exact code.
+"""
+
+from __future__ import annotations
+
+from tigerbeetle_tpu.constants import ConfigCluster, ConfigProcess
+from tigerbeetle_tpu.io.network import Network
+from tigerbeetle_tpu.io.storage import Storage
+from tigerbeetle_tpu.io.time import Time
+from tigerbeetle_tpu.models.ledger import DeviceLedger
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.durable import (
+    restore_from_snapshot,
+    snapshot_to_superblock,
+)
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
+from tigerbeetle_tpu.vsr.journal import Journal
+from tigerbeetle_tpu.vsr.superblock import SuperBlock
+
+
+class Replica:
+    def __init__(
+        self,
+        replica_index: int,
+        replica_count: int,
+        storage: Storage,
+        network: Network,
+        time: Time,
+        cluster: ConfigCluster,
+        process: ConfigProcess,
+        mode: str = "auto",
+        backend_factory=None,
+    ):
+        self.replica = replica_index
+        self.replica_count = replica_count
+        self.network = network
+        self.time = time
+        self.cluster = cluster
+        backend = (
+            backend_factory()
+            if backend_factory is not None
+            else DeviceLedger(cluster, process, mode=mode)
+        )
+        self.ledger = backend
+        self.sm = StateMachine(backend, cluster)
+        self.journal = Journal(storage, cluster)
+        self.superblock = SuperBlock(storage)
+        self.storage = storage
+
+        self.status = "recovering"
+        self.view = 0
+        self.op = 0  # highest prepared op
+        self.commit_min = 0  # highest committed op
+        self.commit_max = 0  # highest known-committed op cluster-wide
+        self.parent_checksum = 0  # checksum of prepare `self.op`
+        self.commit_checksum = 0  # checksum of prepare `self.commit_min`
+        self.checkpoint_op = 0
+
+        # primary state
+        self.pipeline: dict[int, dict] = {}  # op -> {header, body, oks}
+        # replicated session state: client_id -> {session, request, reply}
+        self.client_table: dict[int, dict] = {}
+        # backup reorder buffer for out-of-order prepares
+        self._pending_prepares: dict[int, tuple[Header, bytes]] = {}
+
+        network.attach(replica_index, self._on_message)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    @property
+    def primary_index(self) -> int:
+        return self.view % self.replica_count
+
+    @property
+    def is_primary(self) -> bool:
+        return self.replica == self.primary_index and self.status == "normal"
+
+    def open(self) -> None:
+        """Superblock -> snapshot -> WAL replay (same recovery as the
+        single-replica DurableLedger, then join the cluster)."""
+        state = self.superblock.open()
+        restore_from_snapshot(
+            self.storage, self.ledger, self.sm, self.ledger.process, state
+        )
+        self.client_table = {
+            int(c): dict(e, reply=None)
+            for c, e in state.meta.get("client_table", {}).items()
+        }
+        self.checkpoint_op = state.commit_min
+        self.commit_min = self.commit_max = self.op = state.commit_min
+        self.parent_checksum = self.commit_checksum = state.commit_min_checksum
+        recovered = self.journal.recover()
+        op = state.commit_min + 1
+        while op in recovered:
+            header, body = self.journal.read_prepare(op)  # type: ignore
+            assert header.parent == self.parent_checksum
+            self._commit_prepare(header, body)
+            self.op = op
+            self.parent_checksum = self.commit_checksum = header.checksum
+            self.commit_min = self.commit_max = op
+            op += 1
+        self.status = "normal"
+
+    def checkpoint(self) -> None:
+        """Durably snapshot the committed state AT commit_min (pipelined
+        ops beyond it stay replayable in the WAL). The replicated client
+        table rides in the snapshot meta — it is part of the replicated
+        state (reference: src/vsr/superblock.zig ClientSessions trailer)."""
+        table = {
+            str(c): {"session": e["session"], "request": e["request"]}
+            for c, e in self.client_table.items()
+        }
+        snapshot_to_superblock(
+            self.storage, self.ledger, self.sm, self.superblock,
+            commit_min=self.commit_min,
+            commit_min_checksum=self.commit_checksum,
+            extra_meta={"client_table": table},
+        )
+        self.checkpoint_op = self.commit_min
+
+    def _maybe_checkpoint(self, next_op: int) -> None:
+        """WAL-wrap guard: never let a prepare overwrite an op that is not
+        covered by a checkpoint (reference: src/vsr.zig:2003-2035 keeps a
+        bar of headroom)."""
+        if next_op - self.checkpoint_op >= self.cluster.checkpoint_interval:
+            self.checkpoint()  # snapshots at commit_min
+        assert next_op - self.checkpoint_op < self.cluster.journal_slot_count, (
+            "WAL would wrap uncommitted ops: pipeline stuck"
+        )
+
+    # ------------------------------------------------------------------
+    # message dispatch
+    # ------------------------------------------------------------------
+
+    def _on_message(self, src, data: bytes) -> None:
+        header = Header.from_bytes(data[:HEADER_SIZE])
+        if not header.valid_checksum():
+            return  # corrupt: drop (reference: message_bus checksum gate)
+        body = data[HEADER_SIZE : header.size]
+        if not header.valid_checksum_body(body):
+            return
+        if self.status != "normal":
+            return
+        cmd = Command(header.command)
+        if cmd == Command.request:
+            self._on_request(header, body)
+        elif cmd == Command.prepare:
+            self._on_prepare(header, body)
+        elif cmd == Command.prepare_ok:
+            self._on_prepare_ok(header)
+        elif cmd == Command.commit:
+            self._on_commit(header)
+
+    def _send(self, dst, header: Header, body: bytes = b"") -> None:
+        header.set_checksum_body(body)
+        header.replica = self.replica
+        header.view = self.view
+        header.cluster = self.superblock.state.cluster if self.superblock.state else 0
+        header.set_checksum()
+        self.network.send(self.replica, dst, header.to_bytes() + body)
+
+    def _broadcast(self, header: Header, body: bytes = b"") -> None:
+        import dataclasses
+
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self._send(r, dataclasses.replace(header), body)
+
+    # ------------------------------------------------------------------
+    # primary: request -> prepare
+    # ------------------------------------------------------------------
+
+    @property
+    def quorum_replication(self) -> int:
+        return self.replica_count // 2 + 1
+
+    def _on_request(self, header: Header, body: bytes) -> None:
+        if not self.is_primary:
+            return  # client retries against the right primary
+        client = header.client
+        entry = self.client_table.get(client)
+        operation = Operation(header.operation)
+
+        if operation != Operation.register:
+            if entry is None or header.context != entry["session"]:
+                self._send_eviction(client)
+                return
+            if header.request <= entry["request"]:
+                if header.request == entry["request"] and entry["reply"] is not None:
+                    self.network.send(self.replica, client, entry["reply"])
+                return  # duplicate/stale: drop (reply resent above)
+            # Retransmission of a request still awaiting quorum: already in
+            # the pipeline — preparing it again would execute it twice
+            # (reference: pipeline_prepare_queue message_by_client check).
+            for entry_p in self.pipeline.values():
+                h = entry_p["header"]
+                if h.client == client and h.request == header.request:
+                    return
+
+        op = self.op + 1
+        assert op not in self.pipeline
+        self._maybe_checkpoint(op)
+        if operation != Operation.register:
+            self.sm.prepare(operation, body)
+        prepare = Header(
+            parent=self.parent_checksum,
+            client=client,
+            context=header.checksum,  # checksum of the client's request
+            request=header.request,
+            op=op,
+            commit=self.commit_max,
+            timestamp=(
+                self.sm.prepare_timestamp
+                if operation != Operation.register
+                else self.time.realtime()
+            ),
+            command=int(Command.prepare),
+            operation=int(operation),
+            view=self.view,
+            cluster=self.superblock.state.cluster if self.superblock.state else 0,
+            replica=self.replica,
+        )
+        prepare.set_checksum_body(body)
+        prepare.set_checksum()
+        self.journal.write_prepare(prepare, body)
+        self.op = op
+        self.parent_checksum = prepare.checksum
+        self.pipeline[op] = {"header": prepare, "body": body,
+                             "oks": {self.replica}}
+        self._broadcast_prepare(prepare, body)
+        self._maybe_commit_pipeline()
+
+    def _broadcast_prepare(self, prepare: Header, body: bytes) -> None:
+        for r in range(self.replica_count):
+            if r != self.replica:
+                self.network.send(
+                    self.replica, r, prepare.to_bytes() + body
+                )
+
+    def _send_eviction(self, client: int) -> None:
+        h = Header(command=int(Command.eviction), client=client)
+        self._send(client, h)
+
+    # ------------------------------------------------------------------
+    # backup: prepare -> prepare_ok
+    # ------------------------------------------------------------------
+
+    def _on_prepare(self, header: Header, body: bytes) -> None:
+        if self.is_primary:
+            return
+        if header.op <= self.op:
+            self._ack_prepare(header)  # duplicate: re-ack
+            self._commit_up_to(header.commit)
+            return
+        if header.op > self.op + 1:
+            self._pending_prepares[header.op] = (header, body)
+            return
+        if header.parent != self.parent_checksum:
+            return  # chain break: needs repair (view-change layer)
+        self._maybe_checkpoint(header.op)
+        self.journal.write_prepare(header, body)
+        self.op = header.op
+        self.parent_checksum = header.checksum
+        self._ack_prepare(header)
+        self._commit_up_to(header.commit)
+        # drain any buffered successors
+        nxt = self._pending_prepares.pop(self.op + 1, None)
+        if nxt is not None:
+            self._on_prepare(*nxt)
+
+    def _ack_prepare(self, prepare: Header) -> None:
+        ok = Header(
+            command=int(Command.prepare_ok),
+            op=prepare.op,
+            context=prepare.checksum,
+            client=prepare.client,
+            request=prepare.request,
+            timestamp=prepare.timestamp,
+            operation=prepare.operation,
+        )
+        self._send(self.primary_index, ok)
+
+    # ------------------------------------------------------------------
+    # commit
+    # ------------------------------------------------------------------
+
+    def _on_prepare_ok(self, header: Header) -> None:
+        if not self.is_primary:
+            return
+        entry = self.pipeline.get(header.op)
+        if entry is None or entry["header"].checksum != header.context:
+            return
+        entry["oks"].add(header.replica)
+        self._maybe_commit_pipeline()
+
+    def _maybe_commit_pipeline(self) -> None:
+        committed = False
+        while True:
+            op = self.commit_min + 1
+            entry = self.pipeline.get(op)
+            if entry is None or len(entry["oks"]) < self.quorum_replication:
+                break
+            header, body = entry["header"], entry["body"]
+            reply_body = self._commit_prepare(header, body)
+            self.commit_min = self.commit_max = op
+            self.commit_checksum = header.checksum
+            del self.pipeline[op]
+            self._reply(header, reply_body)
+            committed = True
+        if committed:
+            # commit heartbeat so backups commit promptly (reference sends
+            # these on a timeout; the scripted cluster has no timers yet)
+            h = Header(command=int(Command.commit), commit=self.commit_max)
+            self._broadcast(h)
+
+    def _on_commit(self, header: Header) -> None:
+        if self.is_primary:
+            return
+        self._commit_up_to(header.commit)
+
+    def _commit_up_to(self, commit_max: int) -> None:
+        self.commit_max = max(self.commit_max, commit_max)
+        while self.commit_min < min(self.commit_max, self.op):
+            op = self.commit_min + 1
+            got = self.journal.read_prepare(op)
+            assert got is not None, f"backup missing journaled op {op}"
+            header, body = got
+            self._commit_prepare(header, body)
+            self.commit_min = op
+            self.commit_checksum = header.checksum
+
+    def _commit_prepare(self, header: Header, body: bytes) -> bytes:
+        """Execute one prepare against the replicated state (identical on
+        every replica — determinism is the consensus invariant)."""
+        operation = Operation(header.operation)
+        if operation == Operation.register:
+            self.client_table[header.client] = {
+                "session": header.op,
+                "request": 0,
+                "reply": None,
+            }
+            return header.op.to_bytes(8, "little")  # session number
+        reply = self.sm.commit(operation, header.timestamp, body)
+        self.sm.prepare_timestamp = max(self.sm.prepare_timestamp, header.timestamp)
+        entry = self.client_table.get(header.client)
+        if entry is not None:
+            entry["request"] = header.request
+        return reply
+
+    def _reply(self, prepare: Header, reply_body: bytes) -> None:
+        reply = Header(
+            command=int(Command.reply),
+            client=prepare.client,
+            context=prepare.context,
+            request=prepare.request,
+            op=prepare.op,
+            commit=prepare.op,
+            timestamp=prepare.timestamp,
+            operation=prepare.operation,
+        )
+        reply.set_checksum_body(reply_body)
+        reply.replica = self.replica
+        reply.view = self.view
+        reply.set_checksum()
+        wire = reply.to_bytes() + reply_body
+        entry = self.client_table.get(prepare.client)
+        if entry is not None:
+            entry["reply"] = wire
+        self.network.send(self.replica, prepare.client, wire)
