@@ -276,9 +276,9 @@ def test_durable_ledger_checkpoint_ordering_crash_between():
     dl.checkpoint()  # sequence 2, area 0
     _run_workload(dl.submit, gen, 4, start=5)
 
-    # simulate: blobs of the NEXT checkpoint (area 1) written, superblock not
-    seq = dl.superblock.state.sequence + 1
-    area = (seq % 2) * (storage.layout.sizes[Zone.grid] // 2)
+    # simulate: blobs of the NEXT checkpoint (the other ping-pong area)
+    # written, superblock not
+    area = (1 - dl.superblock.state.area) * (storage.layout.sizes[Zone.grid] // 2)
     storage.write(Zone.grid, area, b"\xAA" * 4096)  # garbage partial blobs
 
     dl2 = DurableLedger(storage, TEST_CLUSTER, TEST_PROCESS)

@@ -1,0 +1,248 @@
+"""View change, repair, and the cluster clock (reference:
+src/vsr/replica.zig:1595-1924 view change; src/vsr/clock.zig Marzullo)."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.io.time import DeterministicTime
+from tigerbeetle_tpu.state_machine import encode_ids
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.state_checker import (
+    assert_convergence,
+    assert_identical_state,
+)
+from tigerbeetle_tpu.testing.workload import WorkloadGenerator
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.clock import Clock, marzullo
+
+
+# ----------------------------------------------------------------------
+# Marzullo / clock
+# ----------------------------------------------------------------------
+
+
+def test_marzullo_basic():
+    # three sources agreeing around +10, one outlier
+    iv = [(8, 12), (9, 13), (7, 11), (100, 104)]
+    w = marzullo(iv, quorum=3)
+    assert w is not None
+    assert 9 <= w.lo <= w.hi <= 11
+    # no quorum point
+    assert marzullo([(0, 1), (10, 11), (20, 21)], quorum=2) is None
+    # quorum of one is just the first-best overlap
+    w = marzullo([(5, 6)], quorum=1)
+    assert (w.lo, w.hi) == (5, 6)
+
+
+def test_clock_synchronizes_against_skewed_peers():
+    time = DeterministicTime(offset_ns=0)
+    clock = Clock(0, 3, time)
+    assert clock.realtime_synchronized() is None  # no samples yet
+    # Peers are skewed +50ms and +60ms; RTT 2 ticks.
+    time.ticks = 100
+    m0 = time.monotonic()
+    time.ticks += 2
+    for peer, skew in ((1, 50_000_000), (2, 60_000_000)):
+        t1 = time.realtime() - time.tick_ns + skew  # peer read mid-RTT
+        clock.learn(peer, m0, t1, time.monotonic())
+    rt = clock.realtime_synchronized()
+    assert rt is not None
+    # Synchronized time is own realtime + a learned offset within the skew
+    # envelope (0 is in the quorum window since self is a source).
+    assert 0 <= rt - time.realtime() <= 60_000_000
+
+
+def test_cluster_clock_synchronizes_in_harness():
+    """Ping/pong round trips within one tick still produce valid (zero
+    width) offset intervals — the synchronized path must come alive."""
+    cluster = Cluster(replica_count=3)
+    cluster.run_ticks(20)
+    for r in cluster.replicas:
+        assert r.clock.realtime_synchronized() is not None, r.replica
+
+
+def test_register_retransmit_no_second_session():
+    """A duplicate register must answer from the table, not mint a second
+    session that evicts the client."""
+    cluster = Cluster(replica_count=3)
+    client = cluster.add_client()
+    session = client.session
+    commit = cluster.replicas[0].commit_min
+    # simulate a late retransmit of the original register
+    reg = client.in_flight  # cleared — rebuild the register bytes
+    from tigerbeetle_tpu.vsr.header import Command, Header
+
+    h = Header(
+        command=int(Command.request),
+        operation=int(Operation.register),
+        client=client.client_id,
+        request=0,
+    )
+    h.set_checksum_body(b"")
+    h.set_checksum()
+    cluster.network.send(client.client_id, 0, h.to_bytes())
+    cluster.network.run()
+    assert cluster.replicas[0].commit_min == commit  # no second register op
+    assert cluster.replicas[0].client_table[client.client_id]["session"] == session
+    # and the client can still transact
+    body = types.accounts_to_np([types.Account(id=5, ledger=1, code=1)]).tobytes()
+    hreply, r = cluster.execute(client, Operation.create_accounts, body)
+    assert r == b"" and not client.evicted
+
+
+# ----------------------------------------------------------------------
+# view change
+# ----------------------------------------------------------------------
+
+
+def _commit_batches(cluster, client, gen, n, start=0):
+    committed = []
+    for b in range(start, start + n):
+        if b % 3 == 0:
+            op, events = gen.gen_accounts_batch(16)
+            body = types.accounts_to_np(events).tobytes()
+        else:
+            op, events = gen.gen_transfers_batch(16)
+            body = types.transfers_to_np(events).tobytes()
+        header, _ = cluster.execute(client, op, body)
+        committed.append((op, header.timestamp, body))
+    return committed
+
+
+def test_view_change_after_primary_failure():
+    """Kill the primary; backups elect view 1; the client retries and the
+    cluster keeps serving; committed state survives."""
+    cluster = Cluster(replica_count=3)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(41)
+    _commit_batches(cluster, client, gen, 4)
+    committed_before = cluster.replicas[0].commit_min
+
+    cluster.detach_replica(0)  # primary crashes
+    cluster.run_ticks(60)  # silence -> SVC -> DVC -> SV
+    live = cluster.replicas[1:]
+    assert all(r.status == "normal" for r in live)
+    assert all(r.view == 1 for r in live)
+    assert live[0].is_primary  # replica 1 = view 1 % 3
+    assert all(r.commit_min == committed_before for r in live)
+    assert_identical_state(live)
+
+    # client retries against the new primary (broadcast resend)
+    op, events = gen.gen_accounts_batch(16)
+    body = types.accounts_to_np(events).tobytes()
+    client.request(op, body)
+    cluster.network.run()
+    if client.reply is None:
+        client.resend()
+        cluster.network.run()
+    h, _ = client.take_reply()
+    assert h.view == 1
+    assert_convergence(live)
+    assert_identical_state(live)
+
+
+def test_view_change_preserves_uncommitted_quorum_op():
+    """An op prepared by a quorum but whose commit the old primary never
+    announced must survive the view change (VSR's central invariant)."""
+    from tigerbeetle_tpu.vsr.header import Command, Header
+
+    cluster = Cluster(replica_count=3)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(43)
+    _commit_batches(cluster, client, gen, 2)
+    base_commit = cluster.replicas[0].commit_min
+
+    # Block commit heartbeats and replies so backups prepare op but never
+    # learn it committed; then kill the primary.
+    def block(src, dst, data):
+        h = Header.from_bytes(data[:128])
+        if h.command == Command.commit:
+            return False
+        if h.command == Command.reply:
+            return False
+        return True
+
+    cluster.network.filters.append(block)
+    op, events = gen.gen_accounts_batch(16)
+    body = types.accounts_to_np(events).tobytes()
+    client.request(op, body)
+    cluster.network.run()
+    # primary committed locally (quorum of prepare_oks) but nobody heard
+    assert cluster.replicas[0].commit_min == base_commit + 1
+    assert all(r.commit_min == base_commit for r in cluster.replicas[1:])
+    assert all(r.op == base_commit + 1 for r in cluster.replicas[1:])
+
+    cluster.network.filters.clear()
+    cluster.detach_replica(0)
+    cluster.run_ticks(60)
+    live = cluster.replicas[1:]
+    assert all(r.status == "normal" for r in live)
+    # The prepared op survived the view change and committed in view 1.
+    assert all(r.commit_min == base_commit + 1 for r in live)
+    assert_identical_state(live)
+
+    # the client's retry is answered from the replicated client table
+    # WITHOUT re-execution (the op committed exactly once)
+    commit_after = live[0].commit_min
+    client.resend()
+    cluster.network.run()
+    h1, r1 = client.take_reply()
+    assert live[0].commit_min == commit_after  # answered from the table
+    assert h1.op == base_commit + 1  # the surviving op's reply
+
+
+def test_view_change_cascades_to_next_view():
+    """If the new primary is also down, the next timeout moves to view 2."""
+    cluster = Cluster(replica_count=3)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(44)
+    _commit_batches(cluster, client, gen, 2)
+    committed = cluster.replicas[0].commit_min
+
+    cluster.detach_replica(0)
+    cluster.detach_replica(1)  # view-1 primary also dead
+    cluster.run_ticks(200)
+    # replica 2 alone cannot form a quorum: stays in view_change
+    assert cluster.replicas[2].status == "view_change"
+    assert cluster.replicas[2].view_candidate >= 2
+
+    cluster.reattach_replica(1)
+    cluster.run_ticks(120)
+    live = cluster.replicas[1:]
+    assert all(r.status == "normal" for r in live), [r.status for r in live]
+    v = live[0].view
+    assert v >= 2 and v % 3 != 0  # a view whose primary is alive
+    assert all(r.commit_min == committed for r in live)
+    assert_identical_state(live)
+
+
+def test_restarted_replica_rejoins_current_view():
+    """A replica restarted from disk rejoins, learns the current view via
+    new-view traffic, and catches up."""
+    cluster = Cluster(replica_count=3)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(45)
+    _commit_batches(cluster, client, gen, 3)
+
+    cluster.detach_replica(0)
+    cluster.run_ticks(60)
+    assert cluster.replicas[1].is_primary
+
+    # more commits in view 1 while replica 0 is down
+    client.resend_view = None
+    op, events = gen.gen_accounts_batch(16)
+    body = types.accounts_to_np(events).tobytes()
+    client.request(op, body)
+    cluster.network.run()
+    if client.reply is None:
+        client.resend()
+        cluster.network.run()
+    client.take_reply()
+
+    # restart replica 0 from its storage and let it rejoin
+    r0 = cluster.restart_replica(0)
+    cluster.run_ticks(60)
+    assert r0.view == cluster.replicas[1].view
+    assert r0.commit_min == cluster.replicas[1].commit_min
+    assert_identical_state(cluster.replicas)

@@ -46,6 +46,11 @@ class Cluster:
         self.storages = []
         self.replicas: list[Replica] = []
         self.clients: list[Client] = []
+        self.detached: set[int] = set()
+        self.network.filters.append(
+            lambda src, dst, data: src not in self.detached
+            and dst not in self.detached
+        )
 
         for i in range(replica_count):
             storage = MemoryStorage(self.layout, seed=seed * 97 + i)
@@ -73,10 +78,32 @@ class Cluster:
 
     def execute(self, client: Client, operation: Operation,
                 body: bytes) -> tuple[Header, bytes]:
-        """Send one request and pump the network until its reply arrives."""
+        """Send one request and pump the network until its reply arrives.
+        One broadcast retry models the client's request timeout (it may not
+        know the current primary after a view change)."""
         client.request(operation, body)
         self.network.run()
+        if client.reply is None:
+            client.resend()
+            self.network.run()
         return client.take_reply()
+
+    def run_ticks(self, n: int) -> None:
+        """Advance virtual time: each tick every replica ticks, then the
+        network quiesces (the simulator interleaves these differently)."""
+        for _ in range(n):
+            self.time.tick()
+            for r in self.replicas:
+                if r.replica not in self.detached:
+                    r.tick()
+            self.network.run()
+
+    def detach_replica(self, index: int) -> None:
+        """Crash a replica: no messages in or out, no ticks."""
+        self.detached.add(index)
+
+    def reattach_replica(self, index: int) -> None:
+        self.detached.discard(index)
 
     def restart_replica(self, index: int, backend_factory=None) -> Replica:
         """Crash-restart a replica over its surviving storage bytes."""
@@ -89,5 +116,6 @@ class Cluster:
         )
         r.open()
         self.replicas[index] = r
+        self.detached.discard(index)
         del old
         return r

@@ -85,9 +85,15 @@ class Client:
         self.network.send(self.client_id, self.primary_index, self.in_flight)
 
     def resend(self) -> None:
-        """Retry the in-flight request (timeout / view change)."""
+        """Retry the in-flight request. Broadcast to every replica: after a
+        view change the client may not know the new primary yet; replicas
+        that are not the primary ignore requests (the reference's client
+        learns the view from pings — command=ping_client — and resends to
+        the primary; broadcasting is the transport-equivalent simplification
+        until client pings land)."""
         assert self.in_flight is not None
-        self.network.send(self.client_id, self.primary_index, self.in_flight)
+        for r in range(self.replica_count):
+            self.network.send(self.client_id, r, self.in_flight)
 
     def take_reply(self) -> tuple[Header, bytes]:
         assert self.reply is not None, "no reply pending"

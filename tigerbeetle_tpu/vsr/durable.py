@@ -28,6 +28,8 @@ tables.
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 
 from tigerbeetle_tpu import native
@@ -73,8 +75,12 @@ def snapshot_to_superblock(
     state = superblock.state
     assert state is not None
     sequence = state.sequence + 1
+    # Explicit ping-pong: blobs go to the OTHER area than the live
+    # checkpoint's (sequence numbers may advance without blob writes — view
+    # persistence — so parity alone would not alternate correctly).
+    area = 1 - state.area
     area_size = storage.layout.sizes[Zone.grid] // 2
-    base = (sequence % 2) * area_size
+    base = area * area_size
 
     dev = ledger.state
     blobs: list[BlobRef] = []
@@ -107,9 +113,25 @@ def snapshot_to_superblock(
         commit_min_checksum=commit_min_checksum,
         commit_max=commit_min,
         prepare_timestamp=sm.prepare_timestamp,
+        area=area,
         blobs=blobs,
         meta=meta,
     ))
+
+
+def persist_view(superblock: SuperBlock, view: int, log_view: int) -> None:
+    """Durably record view participation WITHOUT a state snapshot (blob refs
+    carry over; the grid areas are untouched). VSR requires the view to be
+    durable before voting/acking in it — otherwise a crash-restart could
+    regress and form an intersecting quorum in an abandoned view."""
+    state = superblock.state
+    assert state is not None
+    meta = dict(state.meta)
+    meta["view"] = view
+    meta["log_view"] = log_view
+    superblock.checkpoint(
+        dataclasses.replace(state, sequence=state.sequence + 1, meta=meta)
+    )
 
 
 def restore_from_snapshot(

@@ -61,6 +61,17 @@ class Journal:
             bytes(self._headers[sector : sector + SECTOR_SIZE]),
         )
 
+    def get_header(self, op: int) -> Header | None:
+        """The op's header from the in-memory redundant-header mirror (valid
+        for faulty slots too — that is the point of the redundant ring)."""
+        slot = self.slot_for_op(op)
+        h = Header.from_bytes(
+            bytes(self._headers[slot * HEADER_SIZE : (slot + 1) * HEADER_SIZE])
+        )
+        if h.valid_checksum() and h.command == Command.prepare and h.op == op:
+            return h
+        return None
+
     # -- read path --
 
     def read_prepare(self, op: int) -> tuple[Header, bytes] | None:

@@ -1,30 +1,40 @@
 """The VSR replica: consensus-driven replication of the device ledger.
 
-Viewstamped Replication normal path (reference: src/vsr/replica.zig —
-on_request :1208, on_prepare :1262, on_prepare_ok :1346, on_commit :1485,
-commit dispatch :3045-3103):
+Viewstamped Replication (Revisited) over the Storage/Network/Time seams
+(reference: src/vsr/replica.zig — normal path handlers :1208-1538, view
+change :1595-1924, repair :5248+, commit dispatch :3045-3103):
 
-- The PRIMARY (view % replica_count) sequences client requests into
-  prepares: assigns op + batch-final timestamp, hash-chains the header to
-  its predecessor, journals it (WAL-before-ack), broadcasts to backups, and
-  counts prepare_oks (its own journal write included).
-- BACKUPS verify the chain, journal the prepare, and ack prepare_ok.
-- At a replication quorum (majority), the primary commits in op order
-  through the StateMachine (the TPU device ledger), replies to the client,
-  and advances commit_max; backups commit from their journal when the
-  commit number reaches them (piggybacked on prepares + commit heartbeats).
-- Client sessions are part of the replicated state: `register` ops flow
-  through the log and every replica's client table updates identically
-  (reference: src/vsr/replica.zig:3758-3860), so duplicate requests are
-  answered from the table without re-execution.
+NORMAL PATH — the PRIMARY (view % replica_count) sequences client requests
+into prepares: assigns op + batch-final timestamp (cluster clock, monotonic
+clamped), hash-chains the header, journals it (WAL-before-ack), broadcasts;
+BACKUPS verify the chain, journal, ack prepare_ok; at a majority quorum the
+primary commits in op order through the StateMachine (the TPU device
+ledger) and replies; backups commit when the commit number reaches them
+(piggybacked + heartbeats). Client sessions are replicated state: register
+ops flow through the log, duplicates are answered from the table.
 
-View changes / repair / state sync land on top of this (reference
-:1595-1924); status tracks it. All transport is real wire bytes through
-the Network seam; all persistence through the Storage seam — so the
-deterministic cluster (testing/cluster.py) runs this exact code.
+VIEW CHANGE — backups that lose contact with the primary send
+start_view_change for view+1; at a quorum of SVCs each sends do_view_change
+(carrying its log suffix headers) to the new primary; the new primary picks
+the best log (max log_view, then op), repairs missing prepares via
+request_prepare, truncates its tail, then broadcasts start_view; backups
+adopt the suffix, repairing the same way. Uncommitted ops that survive in
+the chosen log commit in the new view (VSR's no-lost-commits invariant:
+any op that reached a commit quorum is in a majority of logs, so the best
+log contains it).
+
+CLOCK — replicas ping each other; pongs return the peer's wall clock, and
+Marzullo's algorithm over the offset intervals (vsr/clock.py) yields a
+cluster-synchronized timestamp base (reference: src/vsr/clock.zig).
+
+All transport is real wire bytes; all persistence goes through the Storage
+seam; ticks through the Time seam — the deterministic cluster and the
+simulator run this exact code.
 """
 
 from __future__ import annotations
+
+import dataclasses
 
 from tigerbeetle_tpu.constants import ConfigCluster, ConfigProcess
 from tigerbeetle_tpu.io.network import Network
@@ -33,13 +43,22 @@ from tigerbeetle_tpu.io.time import Time
 from tigerbeetle_tpu.models.ledger import DeviceLedger
 from tigerbeetle_tpu.state_machine import StateMachine
 from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.clock import Clock
 from tigerbeetle_tpu.vsr.durable import (
+    persist_view,
     restore_from_snapshot,
     snapshot_to_superblock,
 )
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
 from tigerbeetle_tpu.vsr.journal import Journal
 from tigerbeetle_tpu.vsr.superblock import SuperBlock
+
+# Tick-based timeout constants (reference: src/vsr/replica.zig:2479-2843
+# timeout table; values here are in ticks of the Time seam).
+HEARTBEAT_TICKS = 4  # primary: commit heartbeat cadence
+PING_TICKS = 8  # clock sync cadence
+VIEW_CHANGE_TICKS = 40  # backup: silence before starting a view change
+RETRY_TICKS = 16  # view-change message retry cadence
 
 
 class Replica:
@@ -70,9 +89,11 @@ class Replica:
         self.journal = Journal(storage, cluster)
         self.superblock = SuperBlock(storage)
         self.storage = storage
+        self.clock = Clock(replica_index, replica_count, time)
 
         self.status = "recovering"
         self.view = 0
+        self.log_view = 0  # latest view in which status was normal
         self.op = 0  # highest prepared op
         self.commit_min = 0  # highest committed op
         self.commit_max = 0  # highest known-committed op cluster-wide
@@ -86,6 +107,20 @@ class Replica:
         self.client_table: dict[int, dict] = {}
         # backup reorder buffer for out-of-order prepares
         self._pending_prepares: dict[int, tuple[Header, bytes]] = {}
+
+        # repair state: ops whose prepares we asked peers for
+        self._repair_wanted: set[int] = set()
+
+        # tick + view-change state
+        self.ticks = 0
+        self._primary_contact_tick = 0
+        self._vc_tick = 0
+        self._vc_retries = 0
+        self.view_candidate = 0
+        self._svc_votes: set[int] = set()
+        self._dvc: dict[int, tuple[Header, list[Header]]] = {}
+        self._adopt: dict[int, Header] | None = None  # op -> wanted header
+        self._adopt_commit_max = 0
 
         network.attach(replica_index, self._on_message)
 
@@ -101,6 +136,14 @@ class Replica:
     def is_primary(self) -> bool:
         return self.replica == self.primary_index and self.status == "normal"
 
+    @property
+    def quorum_replication(self) -> int:
+        return self.replica_count // 2 + 1
+
+    @property
+    def quorum_view_change(self) -> int:
+        return self.replica_count // 2 + 1
+
     def open(self) -> None:
         """Superblock -> snapshot -> WAL replay (same recovery as the
         single-replica DurableLedger, then join the cluster)."""
@@ -112,6 +155,9 @@ class Replica:
             int(c): dict(e, reply=None)
             for c, e in state.meta.get("client_table", {}).items()
         }
+        persisted_view = int(state.meta.get("view", 0))
+        persisted_log_view = int(state.meta.get("log_view", persisted_view))
+        self.view = self.log_view = persisted_log_view
         self.checkpoint_op = state.commit_min
         self.commit_min = self.commit_max = self.op = state.commit_min
         self.parent_checksum = self.commit_checksum = state.commit_min_checksum
@@ -126,6 +172,12 @@ class Replica:
             self.commit_min = self.commit_max = op
             op += 1
         self.status = "normal"
+        self._primary_contact_tick = self.ticks
+        # Crashed mid-view-change (view voted > last normal view): resume
+        # the view change rather than acting normal in a view we never
+        # finished entering (self-promotion would bypass the DVC quorum).
+        if persisted_view > self.log_view:
+            self._start_view_change(persisted_view)
 
     def checkpoint(self) -> None:
         """Durably snapshot the committed state AT commit_min (pipelined
@@ -140,7 +192,11 @@ class Replica:
             self.storage, self.ledger, self.sm, self.superblock,
             commit_min=self.commit_min,
             commit_min_checksum=self.commit_checksum,
-            extra_meta={"client_table": table},
+            extra_meta={
+                "client_table": table,
+                "view": self.view,
+                "log_view": self.log_view,
+            },
         )
         self.checkpoint_op = self.commit_min
 
@@ -155,6 +211,41 @@ class Replica:
         )
 
     # ------------------------------------------------------------------
+    # ticks / timeouts
+    # ------------------------------------------------------------------
+
+    def tick(self) -> None:
+        self.ticks += 1
+        if self.status == "normal":
+            if self.is_primary:
+                if self.ticks % HEARTBEAT_TICKS == 0:
+                    h = Header(command=int(Command.commit), commit=self.commit_max)
+                    self._broadcast(h)
+            else:
+                if self.ticks - self._primary_contact_tick > VIEW_CHANGE_TICKS:
+                    self._start_view_change(self.view + 1)
+            if self.ticks % PING_TICKS == 0:
+                ping = Header(command=int(Command.ping), op=self.time.monotonic())
+                self._broadcast(ping)
+        elif self.status == "view_change":
+            if self.ticks - self._vc_tick > RETRY_TICKS:
+                self._vc_retries += 1
+                if self._vc_retries >= 2:
+                    # The candidate view is not completing (its primary may
+                    # be down too): escalate to the next view (reference:
+                    # view_change_status_timeout increments the view).
+                    self._start_view_change(self.view_candidate + 1)
+                else:
+                    self._vc_tick = self.ticks
+                    svc = Header(
+                        command=int(Command.start_view_change),
+                        view=self.view_candidate,
+                    )
+                    self._broadcast(svc)
+                    if len(self._svc_votes) >= self.quorum_view_change:
+                        self._send_do_view_change()
+
+    # ------------------------------------------------------------------
     # message dispatch
     # ------------------------------------------------------------------
 
@@ -165,9 +256,47 @@ class Replica:
         body = data[HEADER_SIZE : header.size]
         if not header.valid_checksum_body(body):
             return
+        cmd = Command(header.command)
+        # Commands valid in any status:
+        if cmd == Command.ping:
+            pong = Header(
+                command=int(Command.pong), op=header.op,
+                timestamp=self.clock.realtime(),
+            )
+            self._send(header.replica, pong)
+            return
+        if cmd == Command.pong:
+            self.clock.learn(
+                header.replica, header.op, header.timestamp,
+                self.time.monotonic(),
+            )
+            return
+        if cmd == Command.request_prepare:
+            self._on_request_prepare(header)
+            return
+        if cmd == Command.start_view_change:
+            self._on_start_view_change(header)
+            return
+        if cmd == Command.do_view_change:
+            self._on_do_view_change(header, body)
+            return
+        if cmd == Command.start_view:
+            self._on_start_view(header, body)
+            return
+        if cmd == Command.request_start_view:
+            self._on_request_start_view(header)
+            return
+
+        if self.status == "view_change" and cmd == Command.prepare:
+            self._on_repair_prepare(header, body)
+            return
         if self.status != "normal":
             return
-        cmd = Command(header.command)
+        # A message from a newer view: we missed a view change — catch up.
+        if header.view > self.view and cmd in (Command.prepare, Command.commit):
+            rsv = Header(command=int(Command.request_start_view), view=header.view)
+            self._send(header.view % self.replica_count, rsv)
+            return
         if cmd == Command.request:
             self._on_request(header, body)
         elif cmd == Command.prepare:
@@ -180,14 +309,13 @@ class Replica:
     def _send(self, dst, header: Header, body: bytes = b"") -> None:
         header.set_checksum_body(body)
         header.replica = self.replica
-        header.view = self.view
+        if header.view == 0 and header.command != int(Command.start_view_change):
+            header.view = self.view
         header.cluster = self.superblock.state.cluster if self.superblock.state else 0
         header.set_checksum()
         self.network.send(self.replica, dst, header.to_bytes() + body)
 
     def _broadcast(self, header: Header, body: bytes = b"") -> None:
-        import dataclasses
-
         for r in range(self.replica_count):
             if r != self.replica:
                 self._send(r, dataclasses.replace(header), body)
@@ -196,10 +324,6 @@ class Replica:
     # primary: request -> prepare
     # ------------------------------------------------------------------
 
-    @property
-    def quorum_replication(self) -> int:
-        return self.replica_count // 2 + 1
-
     def _on_request(self, header: Header, body: bytes) -> None:
         if not self.is_primary:
             return  # client retries against the right primary
@@ -207,7 +331,16 @@ class Replica:
         entry = self.client_table.get(client)
         operation = Operation(header.operation)
 
-        if operation != Operation.register:
+        if operation == Operation.register:
+            # A register retransmit must not create a second session — the
+            # client's real session would be silently replaced and its next
+            # request evicted (reference: duplicate register replies from
+            # the client table).
+            if entry is not None:
+                if entry["reply"] is not None:
+                    self.network.send(self.replica, client, entry["reply"])
+                return
+        else:
             if entry is None or header.context != entry["session"]:
                 self._send_eviction(client)
                 return
@@ -215,18 +348,28 @@ class Replica:
                 if header.request == entry["request"] and entry["reply"] is not None:
                     self.network.send(self.replica, client, entry["reply"])
                 return  # duplicate/stale: drop (reply resent above)
-            # Retransmission of a request still awaiting quorum: already in
-            # the pipeline — preparing it again would execute it twice
-            # (reference: pipeline_prepare_queue message_by_client check).
-            for entry_p in self.pipeline.values():
-                h = entry_p["header"]
-                if h.client == client and h.request == header.request:
-                    return
+        # Retransmission of a request still awaiting quorum: already in
+        # the pipeline — preparing it again would execute it twice
+        # (reference: pipeline_prepare_queue message_by_client check).
+        for entry_p in self.pipeline.values():
+            h = entry_p["header"]
+            if (
+                h.client == client
+                and h.request == header.request
+                and h.operation == header.operation
+            ):
+                return
 
         op = self.op + 1
         assert op not in self.pipeline
         self._maybe_checkpoint(op)
         if operation != Operation.register:
+            # Timestamp base: cluster-synchronized wall clock, clamped
+            # monotonic (reference: src/vsr/replica.zig:5121-5131).
+            rt = self.clock.realtime_synchronized()
+            if rt is None:
+                rt = self.clock.realtime()
+            self.sm.prepare_timestamp = max(self.sm.prepare_timestamp, rt)
             self.sm.prepare(operation, body)
         prepare = Header(
             parent=self.parent_checksum,
@@ -253,15 +396,10 @@ class Replica:
         self.parent_checksum = prepare.checksum
         self.pipeline[op] = {"header": prepare, "body": body,
                              "oks": {self.replica}}
-        self._broadcast_prepare(prepare, body)
-        self._maybe_commit_pipeline()
-
-    def _broadcast_prepare(self, prepare: Header, body: bytes) -> None:
         for r in range(self.replica_count):
             if r != self.replica:
-                self.network.send(
-                    self.replica, r, prepare.to_bytes() + body
-                )
+                self.network.send(self.replica, r, prepare.to_bytes() + body)
+        self._maybe_commit_pipeline()
 
     def _send_eviction(self, client: int) -> None:
         h = Header(command=int(Command.eviction), client=client)
@@ -272,17 +410,38 @@ class Replica:
     # ------------------------------------------------------------------
 
     def _on_prepare(self, header: Header, body: bytes) -> None:
-        if self.is_primary:
+        # Repair fills (any view):
+        if header.op in self._repair_wanted:
+            if header.op == self.op + 1 and header.parent == self.parent_checksum:
+                # catch-up beyond our log head, verified by the hash chain
+                self.journal.write_prepare(header, body)
+                self.op = header.op
+                self.parent_checksum = header.checksum
+                self._repair_wanted.discard(header.op)
+                self._commit_up_to(self.commit_max)  # continues / asks next
+                return
+            # in-log gap (faulty slot): verified against the expected
+            # checksum from the redundant-header mirror
+            want = self.journal.get_header(header.op)
+            if want is not None and want.checksum == header.checksum:
+                if self.journal.read_prepare(header.op) is None:
+                    self.journal.write_prepare(header, body)
+                self._repair_wanted.discard(header.op)
+                self._commit_up_to(self.commit_max)
             return
+        if header.view < self.view or self.is_primary:
+            return
+        self._primary_contact_tick = self.ticks
         if header.op <= self.op:
             self._ack_prepare(header)  # duplicate: re-ack
             self._commit_up_to(header.commit)
             return
         if header.op > self.op + 1:
             self._pending_prepares[header.op] = (header, body)
+            self._request_prepare(header.op - 1, header.replica)
             return
         if header.parent != self.parent_checksum:
-            return  # chain break: needs repair (view-change layer)
+            return  # chain break: resolved by the view-change/repair layer
         self._maybe_checkpoint(header.op)
         self.journal.write_prepare(header, body)
         self.op = header.op
@@ -307,6 +466,24 @@ class Replica:
         self._send(self.primary_index, ok)
 
     # ------------------------------------------------------------------
+    # repair: fetching missing prepares
+    # ------------------------------------------------------------------
+
+    def _request_prepare(self, op: int, from_replica: int) -> None:
+        self._repair_wanted.add(op)
+        rp = Header(command=int(Command.request_prepare), op=op)
+        self._send(from_replica, rp)
+
+    def _on_request_prepare(self, header: Header) -> None:
+        got = self.journal.read_prepare(header.op)
+        if got is None:
+            return
+        p_header, body = got
+        self.network.send(
+            self.replica, header.replica, p_header.to_bytes() + body
+        )
+
+    # ------------------------------------------------------------------
     # commit
     # ------------------------------------------------------------------
 
@@ -327,37 +504,53 @@ class Replica:
             if entry is None or len(entry["oks"]) < self.quorum_replication:
                 break
             header, body = entry["header"], entry["body"]
-            reply_body = self._commit_prepare(header, body)
+            reply_wire = self._commit_prepare(header, body)
             self.commit_min = self.commit_max = op
             self.commit_checksum = header.checksum
             del self.pipeline[op]
-            self._reply(header, reply_body)
+            if reply_wire is not None:
+                self.network.send(self.replica, header.client, reply_wire)
             committed = True
         if committed:
-            # commit heartbeat so backups commit promptly (reference sends
-            # these on a timeout; the scripted cluster has no timers yet)
+            # commit heartbeat so backups commit promptly (also sent on a
+            # tick cadence)
             h = Header(command=int(Command.commit), commit=self.commit_max)
             self._broadcast(h)
 
     def _on_commit(self, header: Header) -> None:
-        if self.is_primary:
+        if header.view < self.view or self.is_primary:
             return
+        self._primary_contact_tick = self.ticks
         self._commit_up_to(header.commit)
 
     def _commit_up_to(self, commit_max: int) -> None:
         self.commit_max = max(self.commit_max, commit_max)
-        while self.commit_min < min(self.commit_max, self.op):
+        while self.commit_min < self.commit_max:
             op = self.commit_min + 1
+            if op > self.op:
+                # Committed cluster-wide but we never prepared it (we were
+                # down/partitioned): fetch it — the fill chains from our
+                # head and advances self.op (lag catch-up; the reference's
+                # state sync covers the beyond-one-WAL case).
+                self._request_prepare(op, self.primary_index)
+                return
             got = self.journal.read_prepare(op)
-            assert got is not None, f"backup missing journaled op {op}"
+            if got is None:
+                # journal gap (e.g. faulty slot): fetch from the primary
+                self._request_prepare(op, self.primary_index)
+                return
             header, body = got
             self._commit_prepare(header, body)
             self.commit_min = op
             self.commit_checksum = header.checksum
 
-    def _commit_prepare(self, header: Header, body: bytes) -> bytes:
+    def _commit_prepare(self, header: Header, body: bytes) -> bytes | None:
         """Execute one prepare against the replicated state (identical on
-        every replica — determinism is the consensus invariant)."""
+        every replica — determinism is the consensus invariant). EVERY
+        replica constructs and stores the reply in its client table
+        (reference: src/vsr/client_replies.zig — replies are replicated so
+        a post-view-change primary can answer duplicate requests); only the
+        primary actually sends it. Returns the reply wire bytes."""
         operation = Operation(header.operation)
         if operation == Operation.register:
             self.client_table[header.client] = {
@@ -365,31 +558,309 @@ class Replica:
                 "request": 0,
                 "reply": None,
             }
-            return header.op.to_bytes(8, "little")  # session number
-        reply = self.sm.commit(operation, header.timestamp, body)
-        self.sm.prepare_timestamp = max(self.sm.prepare_timestamp, header.timestamp)
-        entry = self.client_table.get(header.client)
-        if entry is not None:
-            entry["request"] = header.request
-        return reply
-
-    def _reply(self, prepare: Header, reply_body: bytes) -> None:
+            reply_body = header.op.to_bytes(8, "little")  # session number
+        else:
+            reply_body = self.sm.commit(operation, header.timestamp, body)
+            self.sm.prepare_timestamp = max(
+                self.sm.prepare_timestamp, header.timestamp
+            )
         reply = Header(
             command=int(Command.reply),
-            client=prepare.client,
-            context=prepare.context,
-            request=prepare.request,
-            op=prepare.op,
-            commit=prepare.op,
-            timestamp=prepare.timestamp,
-            operation=prepare.operation,
+            client=header.client,
+            context=header.context,
+            request=header.request,
+            op=header.op,
+            commit=header.op,
+            timestamp=header.timestamp,
+            operation=header.operation,
         )
         reply.set_checksum_body(reply_body)
         reply.replica = self.replica
         reply.view = self.view
         reply.set_checksum()
         wire = reply.to_bytes() + reply_body
-        entry = self.client_table.get(prepare.client)
+        entry = self.client_table.get(header.client)
         if entry is not None:
+            entry["request"] = header.request
             entry["reply"] = wire
-        self.network.send(self.replica, prepare.client, wire)
+        return wire
+
+    # ------------------------------------------------------------------
+    # view change (reference: src/vsr/replica.zig:1595-1924)
+    # ------------------------------------------------------------------
+
+    def _start_view_change(self, new_view: int) -> None:
+        assert new_view > self.view
+        if self.status == "view_change" and new_view <= self.view_candidate:
+            return
+        self.status = "view_change"
+        self.view_candidate = new_view
+        self._svc_votes = {self.replica}
+        self._dvc = {}
+        self._adopt = None
+        self.pipeline = {}
+        self._pending_prepares = {}
+        self._repair_wanted.clear()
+        self._vc_tick = self.ticks
+        self._vc_retries = 0
+        # Durable BEFORE voting: a crash-restart must not regress into an
+        # abandoned view and form an intersecting quorum there.
+        persist_view(self.superblock, new_view, self.log_view)
+        svc = Header(command=int(Command.start_view_change), view=new_view)
+        self._broadcast(svc)
+        self._check_svc_quorum()
+
+    def _on_start_view_change(self, header: Header) -> None:
+        if header.view <= self.view:
+            return
+        if self.status != "view_change" or header.view > self.view_candidate:
+            self._start_view_change(header.view)
+        if header.view == self.view_candidate:
+            self._svc_votes.add(header.replica)
+            self._check_svc_quorum()
+
+    def _check_svc_quorum(self) -> None:
+        if (
+            self.status == "view_change"
+            and len(self._svc_votes) >= self.quorum_view_change
+        ):
+            self._send_do_view_change()
+
+    def _suffix_headers(self) -> list[Header]:
+        """Headers of ops (commit_min, op] — the log suffix the DVC/SV
+        carries (bounded by the pipeline depth)."""
+        out = []
+        for op in range(self.commit_min + 1, self.op + 1):
+            got = self.journal.read_prepare(op)
+            if got is None:
+                break  # faulty tail slot: advertise only up to the gap
+            out.append(got[0])
+        return out
+
+    def _send_do_view_change(self) -> None:
+        new_primary = self.view_candidate % self.replica_count
+        suffix = self._suffix_headers()
+        body = b"".join(h.to_bytes() for h in suffix)
+        # DVC fields (reference: do_view_change sets request=log_view,
+        # commit=commit_min, op=log head; the suffix headers ride the body).
+        dvc = Header(
+            command=int(Command.do_view_change),
+            view=self.view_candidate,
+            request=self.log_view,
+            op=self.commit_min + len(suffix),
+            commit=self.commit_min,
+            parent=self.commit_checksum,
+        )
+        if new_primary == self.replica:
+            self._record_dvc(self.replica, dvc, suffix)
+        else:
+            self._send(new_primary, dvc, body)
+
+    def _on_do_view_change(self, header: Header, body: bytes) -> None:
+        if header.view % self.replica_count != self.replica:
+            return
+        if header.view < self.view_candidate or (
+            self.status == "normal" and header.view <= self.view
+        ):
+            return
+        if self.status != "view_change" or header.view > self.view_candidate:
+            self._start_view_change(header.view)
+        suffix = [
+            Header.from_bytes(body[i : i + HEADER_SIZE])
+            for i in range(0, len(body), HEADER_SIZE)
+        ]
+        self._record_dvc(header.replica, header, suffix)
+
+    def _record_dvc(self, replica: int, header: Header, suffix: list[Header]):
+        self._dvc[replica] = (header, suffix)
+        if self._adopt is None and len(self._dvc) >= self.quorum_view_change:
+            # Choose the best log: max (log_view, op) (reference:
+            # :2845-2977 primary_receive_do_view_change).
+            best_replica, (best_h, best_suffix) = max(
+                self._dvc.items(),
+                key=lambda kv: (kv[1][0].request, kv[1][0].op),
+            )
+            commit_max = max(h.commit for h, _ in self._dvc.values())
+            self._begin_adoption(
+                base=best_h.commit,
+                suffix={h.op: h for h in best_suffix},
+                commit_max=commit_max,
+                src=best_replica,
+            )
+
+    # -- adoption: two phases shared by the new primary (from DVCs) and
+    # backups (from SV). Phase 1: chain catch-up of COMMITTED ops up to the
+    # suffix base (hash-chain-verified fills from `src`). Phase 2: the
+    # suffix itself, checksum-verified against the adopted headers. --
+
+    def _begin_adoption(self, base: int, suffix: dict[int, Header],
+                        commit_max: int, src: int) -> None:
+        self._adopt = suffix
+        self._adopt_base = base
+        self._adopt_commit_max = max(commit_max, base)
+        self._adopt_src = src
+        # Truncate the log head to the committed prefix: our uncommitted
+        # tail may diverge from the chosen log (its journal rows remain and
+        # are revalidated by checksum below; the state machine never saw
+        # them — only committed ops execute).
+        self.op = self.commit_min
+        self.parent_checksum = self.commit_checksum
+        self._fast_forward(limit=base)
+        if self.op < base and src != self.replica:
+            self._request_prepare(self.op + 1, src)
+        for op, h in suffix.items():
+            got = self.journal.read_prepare(op)
+            if got is None or got[0].checksum != h.checksum:
+                if src == self.replica:
+                    raise AssertionError("best log is local but unreadable")
+                self._request_prepare(op, src)
+        self._try_finish_view_change()
+
+    def _fast_forward(self, limit: int) -> None:
+        """Advance the log head through locally-journaled ops that chain
+        correctly (avoids refetching what we already hold)."""
+        while self.op < limit:
+            got = self.journal.read_prepare(self.op + 1)
+            if got is None or got[0].parent != self.parent_checksum:
+                return
+            self.op += 1
+            self.parent_checksum = got[0].checksum
+
+    def _on_repair_prepare(self, header: Header, body: bytes) -> None:
+        """A prepare arriving while in view_change: either a chain catch-up
+        fill below the suffix base or an adopted suffix prepare."""
+        if self._adopt is None:
+            return
+        if (
+            header.op == self.op + 1
+            and header.op <= self._adopt_base
+            and header.parent == self.parent_checksum
+        ):
+            self.journal.write_prepare(header, body)
+            self.op = header.op
+            self.parent_checksum = header.checksum
+            self._repair_wanted.discard(header.op)
+            self._fast_forward(limit=self._adopt_base)
+            if self.op < self._adopt_base:
+                self._request_prepare(self.op + 1, self._adopt_src)
+            self._try_finish_view_change()
+            return
+        want = self._adopt.get(header.op)
+        if want is None or want.checksum != header.checksum:
+            return
+        self.journal.write_prepare(header, body)
+        self._repair_wanted.discard(header.op)
+        self._try_finish_view_change()
+
+    def _adoption_complete(self) -> bool:
+        assert self._adopt is not None
+        if self.op < self._adopt_base:
+            return False  # catch-up still in flight
+        for op, h in self._adopt.items():
+            got = self.journal.read_prepare(op)
+            if got is None or got[0].checksum != h.checksum:
+                return False
+        return True
+
+    def _try_finish_view_change(self) -> None:
+        if self._adopt is None or not self._adoption_complete():
+            return
+        new_primary = self.view_candidate % self.replica_count
+        if new_primary == self.replica:
+            self._finish_view_change(primary=True)
+        else:
+            self._finish_view_change(primary=False)
+
+    def _finish_view_change(self, primary: bool) -> None:
+        assert self._adopt is not None
+        ops = sorted(self._adopt)
+        base = self._adopt_base
+        assert self.op >= base
+        if ops:
+            self.op = ops[-1]
+            self.parent_checksum = self._adopt[ops[-1]].checksum
+        else:
+            self.op = base
+            self.parent_checksum = self._checksum_of(base)
+        self.view = self.view_candidate
+        self.log_view = self.view
+        persist_view(self.superblock, self.view, self.log_view)
+        self.status = "normal"
+        self._primary_contact_tick = self.ticks
+        adopt_commit_max = self._adopt_commit_max
+        self._adopt = None
+        self._dvc = {}
+        self._repair_wanted.clear()
+        if primary:
+            suffix = self._suffix_headers()
+            sv = Header(
+                command=int(Command.start_view),
+                view=self.view,
+                op=self.op,
+                commit=self.commit_min,
+            )
+            self._broadcast(sv, b"".join(h.to_bytes() for h in suffix))
+            # Surviving uncommitted suffix ops re-enter the pipeline;
+            # backups re-ack them from their adopted SV suffix and quorum
+            # recommits them in the new view (commits survive view changes).
+            for op in range(self.commit_min + 1, self.op + 1):
+                got = self.journal.read_prepare(op)
+                assert got is not None
+                h, body = got
+                self.pipeline[op] = {
+                    "header": h, "body": body, "oks": {self.replica}
+                }
+            self._commit_up_to(adopt_commit_max)
+        else:
+            self._commit_up_to(adopt_commit_max)
+            # Re-ack the adopted-but-uncommitted tail so the new primary
+            # can reach quorum and commit it in the new view.
+            for op in range(self.commit_min + 1, self.op + 1):
+                got = self.journal.read_prepare(op)
+                if got is not None:
+                    self._ack_prepare(got[0])
+
+    def _checksum_of(self, op: int) -> int:
+        if op == 0:
+            return 0
+        if op == self.commit_min:
+            return self.commit_checksum
+        got = self.journal.read_prepare(op)
+        assert got is not None
+        return got[0].checksum
+
+    def _on_start_view(self, header: Header, body: bytes) -> None:
+        if header.view < self.view or (
+            header.view == self.view and self.status == "normal"
+        ):
+            return
+        suffix = [
+            Header.from_bytes(body[i : i + HEADER_SIZE])
+            for i in range(0, len(body), HEADER_SIZE)
+        ]
+        self.status = "view_change"
+        self.view_candidate = header.view
+        self.pipeline = {}
+        self._pending_prepares = {}
+        self._repair_wanted.clear()
+        persist_view(self.superblock, header.view, self.log_view)
+        self._begin_adoption(
+            base=header.commit,
+            suffix={h.op: h for h in suffix},
+            commit_max=header.commit,
+            src=header.replica,
+        )
+
+    def _on_request_start_view(self, header: Header) -> None:
+        if not self.is_primary or header.view != self.view:
+            return
+        suffix = self._suffix_headers()
+        sv = Header(
+            command=int(Command.start_view),
+            view=self.view,
+            op=self.op,
+            commit=self.commit_min,
+        )
+        self._send(
+            header.replica, sv, b"".join(h.to_bytes() for h in suffix)
+        )

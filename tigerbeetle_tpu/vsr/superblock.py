@@ -52,6 +52,7 @@ class VSRState:
     view: int = 0
     log_view: int = 0
     prepare_timestamp: int = 0
+    area: int = 0  # grid area holding `blobs` (explicit ping-pong side)
     blobs: list[BlobRef] = dataclasses.field(default_factory=list)
     meta: dict = dataclasses.field(default_factory=dict)  # small host state
 
