@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""The VOPR fleet runner (reference: src/vopr.zig): run batches of
+simulator seeds, report failures with their replay seed.
+
+Usage: python scripts/vopr.py [--seeds N] [--start S] [--ticks T] [--device]
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+import tests.conftest  # noqa: F401, E402 — CPU platform before jax init
+
+from tigerbeetle_tpu.testing.simulator import run_simulation  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=20)
+    ap.add_argument("--start", type=int, default=1)
+    ap.add_argument("--ticks", type=int, default=1000)
+    ap.add_argument("--device", action="store_true",
+                    help="device-ledger backend (slow)")
+    args = ap.parse_args()
+
+    failures = []
+    t0 = time.time()
+    for seed in range(args.start, args.start + args.seeds):
+        kw = {}
+        if args.device:
+            kw["backend_factory"] = None
+            kw["n_clients"] = 1
+        try:
+            stats = run_simulation(seed, ticks=args.ticks, **kw)
+            print(
+                f"seed {seed:6d} ok: committed={stats['committed_ops']:5d} "
+                f"replies={stats['replies']:5d} crashes={stats['crashes']} "
+                f"wal_faults={stats['wal_faults']} view={stats['view']}"
+            )
+        except Exception as e:  # noqa: BLE001 — report and continue the fleet
+            failures.append(seed)
+            print(f"seed {seed:6d} FAIL: {type(e).__name__}: {str(e)[:160]}")
+    dt = time.time() - t0
+    print(f"\n{args.seeds - len(failures)}/{args.seeds} passed in {dt:.0f}s")
+    if failures:
+        print(f"replay failures with: python scripts/vopr.py --start <seed> --seeds 1")
+        print(f"failing seeds: {failures}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

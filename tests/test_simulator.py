@@ -1,0 +1,54 @@
+"""The VOPR-equivalent simulator (reference: src/simulator.zig; SURVEY §4
+tier 3): seeded end-to-end cluster runs under crashes, partitions, packet
+loss/replay/reorder, and WAL fault injection, checked for one linear
+history, convergence, and bit-exact oracle parity."""
+
+import pytest
+
+from tigerbeetle_tpu.testing.simulator import Simulator, run_simulation
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 7, 14])
+def test_simulation_seeds(seed):
+    stats = run_simulation(seed, ticks=600)
+    assert stats["committed_ops"] > 20
+    assert stats["replies"] > 10
+
+
+def test_simulation_deterministic():
+    """Same seed => identical run (the property that makes failures
+    replayable; reference: src/simulator.zig:66-71)."""
+    a = run_simulation(42, ticks=400)
+    b = run_simulation(42, ticks=400)
+    assert a == b
+
+
+def test_simulation_heavy_faults():
+    """Aggressive loss + partitions still converge."""
+    from tigerbeetle_tpu.testing.packet_simulator import PacketSimulatorOptions
+
+    stats = run_simulation(
+        5,
+        ticks=700,
+        crash_probability=0.004,
+        options=PacketSimulatorOptions(
+            packet_loss_probability=0.05,
+            packet_replay_probability=0.05,
+            partition_probability=0.01,
+        ),
+    )
+    assert stats["committed_ops"] > 10
+
+
+def test_simulation_device_backend():
+    """One seed with the REAL device-ledger backend behind every replica
+    (slow: jit commits on the CPU mesh) — the TPU kernels under consensus,
+    crashes and all."""
+    stats = run_simulation(
+        3,
+        ticks=260,
+        backend_factory=None,  # default: DeviceLedger
+        n_clients=1,
+        crash_probability=0.003,
+    )
+    assert stats["committed_ops"] > 5

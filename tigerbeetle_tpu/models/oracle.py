@@ -54,6 +54,8 @@ class _Scope:
 class OracleStateMachine:
     """Exact semantics of reference src/state_machine.zig over dict stores."""
 
+    process = None  # no device table geometry (Replica backend duck-typing)
+
     def __init__(self) -> None:
         self.accounts: dict[int, Account] = {}
         self.transfers: dict[int, Transfer] = {}
@@ -194,6 +196,61 @@ class OracleStateMachine:
         for index, result in sparse:
             dense[index] = result
         return dense
+
+    # -- parity extraction + snapshot (so the oracle can stand in for the
+    # device ledger behind the Replica in logic-level simulations) --
+
+    def extract(self):
+        return (
+            {k: dataclasses.replace(v) for k, v in self.accounts.items()},
+            {k: dataclasses.replace(v) for k, v in self.transfers.items()},
+            dict(self.posted),
+        )
+
+    def snapshot_bytes(self) -> bytes:
+        import json
+
+        from tigerbeetle_tpu.types import accounts_to_np, transfers_to_np
+
+        acc = accounts_to_np([self.accounts[k] for k in sorted(self.accounts)])
+        xfr = transfers_to_np([self.transfers[k] for k in sorted(self.transfers)])
+        posted = json.dumps(
+            [[str(k), v] for k, v in sorted(self.posted.items())]
+        ).encode()
+        head = (
+            len(acc).to_bytes(8, "little")
+            + len(xfr).to_bytes(8, "little")
+            + len(posted).to_bytes(8, "little")
+            + self.commit_timestamp.to_bytes(8, "little")
+        )
+        return head + acc.tobytes() + xfr.tobytes() + posted
+
+    def restore_bytes(self, raw: bytes) -> None:
+        import json
+
+        import numpy as np
+
+        from tigerbeetle_tpu.types import ACCOUNT_DTYPE, TRANSFER_DTYPE
+
+        n_acc = int.from_bytes(raw[0:8], "little")
+        n_xfr = int.from_bytes(raw[8:16], "little")
+        n_posted = int.from_bytes(raw[16:24], "little")
+        self.commit_timestamp = int.from_bytes(raw[24:32], "little")
+        off = 32
+        acc = np.frombuffer(raw[off : off + 128 * n_acc], dtype=ACCOUNT_DTYPE)
+        off += 128 * n_acc
+        xfr = np.frombuffer(raw[off : off + 128 * n_xfr], dtype=TRANSFER_DTYPE)
+        off += 128 * n_xfr
+        posted = json.loads(raw[off : off + n_posted].decode())
+        self.accounts = {}
+        for i in range(n_acc):
+            a = Account.from_np(acc[i])
+            self.accounts[a.id] = a
+        self.transfers = {}
+        for i in range(n_xfr):
+            t = Transfer.from_np(xfr[i])
+            self.transfers[t.id] = t
+        self.posted = {int(k): v for k, v in posted}
 
     def lookup_accounts(self, ids: Iterable[int]) -> list[Account]:
         # reference: src/state_machine.zig:701-717

@@ -118,4 +118,11 @@ class Cluster:
         self.replicas[index] = r
         self.detached.discard(index)
         del old
+        # Recovering replicas rejoin via request_start_view -> start_view;
+        # pump until the handshake settles (ticks drive retries if needed).
+        self.network.run()
+        for _ in range(3 * 40):
+            if r.status == "normal":
+                break
+            self.run_ticks(1)
         return r

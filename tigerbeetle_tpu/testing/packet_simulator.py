@@ -1,0 +1,121 @@
+"""Virtual network with seeded faults: delay, loss, duplication, reorder,
+partitions (reference: src/testing/packet_simulator.zig:79 — delay, loss,
+replay, clogging, 5 partition modes).
+
+Deterministic: a seed fixes every decision; messages deliver on virtual
+ticks through a priority queue ordered by (deliver_tick, sequence), so the
+same seed always produces the same interleaving.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Callable
+
+from tigerbeetle_tpu.io.network import Address, Handler, Network
+
+
+class PacketSimulatorOptions:
+    def __init__(
+        self,
+        one_way_delay_min: int = 1,
+        one_way_delay_max: int = 4,
+        packet_loss_probability: float = 0.0,
+        packet_replay_probability: float = 0.0,
+        partition_probability: float = 0.0,  # per tick: start a partition
+        unpartition_probability: float = 0.2,  # per tick: heal it
+    ):
+        self.one_way_delay_min = one_way_delay_min
+        self.one_way_delay_max = one_way_delay_max
+        self.packet_loss_probability = packet_loss_probability
+        self.packet_replay_probability = packet_replay_probability
+        self.partition_probability = partition_probability
+        self.unpartition_probability = unpartition_probability
+
+
+class PacketSimulator(Network):
+    def __init__(self, seed: int, replica_count: int,
+                 options: PacketSimulatorOptions | None = None):
+        self.rng = random.Random(seed)
+        self.replica_count = replica_count
+        self.options = options or PacketSimulatorOptions()
+        self.handlers: dict[Address, Handler] = {}
+        self.queue: list[tuple[int, int, Address, Address, bytes]] = []
+        self._seq = 0
+        self.tick_now = 0
+        # partition: a set of replicas isolated from the rest (clients count
+        # as being on the majority side)
+        self.partition: set[int] = set()
+        self.crashed: set[int] = set()
+        self.stats = {"sent": 0, "delivered": 0, "lost": 0, "replayed": 0,
+                      "partitioned_drops": 0}
+
+    def attach(self, addr: Address, handler: Handler) -> None:
+        self.handlers[addr] = handler
+
+    # -- faults --
+
+    def _is_replica(self, addr: Address) -> bool:
+        return 0 <= addr < self.replica_count
+
+    def _cut(self, src: Address, dst: Address) -> bool:
+        if src in self.crashed or dst in self.crashed:
+            return True
+        if not self.partition:
+            return False
+        a = src in self.partition if self._is_replica(src) else False
+        b = dst in self.partition if self._is_replica(dst) else False
+        return a != b  # across the partition boundary
+
+    def step_partitions(self) -> None:
+        o = self.options
+        if self.partition:
+            if self.rng.random() < o.unpartition_probability:
+                self.partition = set()
+        elif o.partition_probability > 0 and self.rng.random() < o.partition_probability:
+            # isolate a random minority of replicas
+            k = self.rng.randint(1, (self.replica_count - 1) // 2)
+            self.partition = set(self.rng.sample(range(self.replica_count), k))
+
+    # -- transport --
+
+    def send(self, src: Address, dst: Address, data: bytes) -> None:
+        self.stats["sent"] += 1
+        o = self.options
+        if self._cut(src, dst):
+            self.stats["partitioned_drops"] += 1
+            return
+        if o.packet_loss_probability and self.rng.random() < o.packet_loss_probability:
+            self.stats["lost"] += 1
+            return
+        copies = 1
+        if o.packet_replay_probability and self.rng.random() < o.packet_replay_probability:
+            copies = 2
+            self.stats["replayed"] += 1
+        for _ in range(copies):
+            delay = self.rng.randint(o.one_way_delay_min, o.one_way_delay_max)
+            self._seq += 1
+            heapq.heappush(
+                self.queue,
+                (self.tick_now + delay, self._seq, src, dst, bytes(data)),
+            )
+
+    def tick(self) -> int:
+        """Advance one tick; deliver everything due. Handlers may send more
+        (those land on later ticks). Returns messages delivered."""
+        self.tick_now += 1
+        self.step_partitions()
+        n = 0
+        while self.queue and self.queue[0][0] <= self.tick_now:
+            _, _, src, dst, data = heapq.heappop(self.queue)
+            if self._cut(src, dst):
+                self.stats["partitioned_drops"] += 1
+                continue
+            handler = self.handlers.get(dst)
+            if handler is None:
+                continue
+            self.stats["delivered"] += 1
+            handler(src, data)
+            n += 1
+        return n

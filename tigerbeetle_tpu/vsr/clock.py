@@ -59,16 +59,20 @@ def marzullo(intervals: list[tuple[int, int]], quorum: int) -> Interval | None:
 
 
 class Clock:
-    """Per-replica clock state; fed by the replica's ping/pong traffic."""
+    """Per-replica clock state; fed by the replica's ping/pong traffic.
+    Samples expire (the reference re-samples in windowed epochs, reference:
+    src/vsr/clock.zig epoch handling) so a long-dead peer's stale offset
+    cannot keep steering the synchronized time."""
 
     def __init__(self, replica: int, replica_count: int, time: Time,
-                 epoch_max_samples: int = 8):
+                 sample_age_max_ns: int = 10_000_000_000):
         self.replica = replica
         self.replica_count = replica_count
         self.time = time
-        # Freshest offset interval per peer (self is implicit [0, 0]).
-        self.samples: dict[int, tuple[int, int]] = {}
-        self.window: Interval | None = None
+        self.sample_age_max_ns = sample_age_max_ns
+        # Freshest offset interval per peer + the monotonic time it was
+        # taken (self is implicit [0, 0], never stale).
+        self.samples: dict[int, tuple[int, int, int]] = {}
 
     @property
     def quorum(self) -> int:
@@ -87,12 +91,16 @@ class Clock:
         own_monotonic = self.time.monotonic()
         # Project both bounds to "peer_realtime - own_realtime" offsets.
         base = own_realtime - own_monotonic
-        self.samples[peer] = (t1 - (base + m2), t1 - (base + m0))
-        self._synchronize()
+        self.samples[peer] = (t1 - (base + m2), t1 - (base + m0), own_monotonic)
 
-    def _synchronize(self) -> None:
-        intervals = [(0, 0)] + list(self.samples.values())
-        self.window = marzullo(intervals, self.quorum)
+    def _window(self) -> Interval | None:
+        now = self.time.monotonic()
+        fresh = [
+            (lo, hi)
+            for lo, hi, taken in self.samples.values()
+            if now - taken <= self.sample_age_max_ns
+        ]
+        return marzullo([(0, 0)] + fresh, self.quorum)
 
     # -- reading --
 
@@ -100,9 +108,10 @@ class Clock:
         return self.time.realtime()
 
     def realtime_synchronized(self) -> int | None:
-        """Cluster-synchronized wall time, or None when no quorum window
-        exists yet (timestamp assignment must wait)."""
-        if self.window is None:
+        """Cluster-synchronized wall time, or None when no quorum of FRESH
+        samples exists (timestamp assignment must wait)."""
+        window = self._window()
+        if window is None:
             return None
-        midpoint = (self.window.lo + self.window.hi) // 2
+        midpoint = (window.lo + window.hi) // 2
         return self.time.realtime() + midpoint

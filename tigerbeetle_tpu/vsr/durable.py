@@ -82,27 +82,33 @@ def snapshot_to_superblock(
     area_size = storage.layout.sizes[Zone.grid] // 2
     base = area * area_size
 
-    dev = ledger.state
     blobs: list[BlobRef] = []
     off = base
-    for name in SNAPSHOT_LEAVES:
-        data = np.asarray(dev[name]).tobytes()
+    if hasattr(ledger, "state"):  # device ledger: HBM tables as blobs
+        dev = ledger.state
+        for name in SNAPSHOT_LEAVES:
+            data = np.asarray(dev[name]).tobytes()
+            assert off + len(data) <= base + area_size, "grid area overflow"
+            storage.write(Zone.grid, off, data)
+            blobs.append(BlobRef(name, off, len(data), native.checksum(data)))
+            off += (len(data) + 4095) // 4096 * 4096
+        h = ledger.hazards
+        meta = {
+            "counters": {k: int(np.asarray(dev[k])) for k in COUNTER_LEAVES},
+            "fault": int(np.asarray(dev["fault"])),
+            "acct_used": ledger._acct_used,
+            "xfer_used": ledger._xfer_used,
+            "amount_sum": str(h.amount_sum),  # may exceed u64: JSON as str
+            "limit_account_ids": [str(x) for x in sorted(h.limit_account_ids)],
+            **(extra_meta or {}),
+        }
+        assert meta["fault"] == 0, "refusing to checkpoint a faulted ledger"
+    else:  # scalar oracle backend (logic-level simulation): one blob
+        data = ledger.snapshot_bytes()
         assert off + len(data) <= base + area_size, "grid area overflow"
         storage.write(Zone.grid, off, data)
-        blobs.append(BlobRef(name, off, len(data), native.checksum(data)))
-        off += (len(data) + 4095) // 4096 * 4096
-
-    h = ledger.hazards
-    meta = {
-        "counters": {k: int(np.asarray(dev[k])) for k in COUNTER_LEAVES},
-        "fault": int(np.asarray(dev["fault"])),
-        "acct_used": ledger._acct_used,
-        "xfer_used": ledger._xfer_used,
-        "amount_sum": str(h.amount_sum),  # may exceed u64: JSON as str
-        "limit_account_ids": [str(x) for x in sorted(h.limit_account_ids)],
-        **(extra_meta or {}),
-    }
-    assert meta["fault"] == 0, "refusing to checkpoint a faulted ledger"
+        blobs.append(BlobRef("oracle", off, len(data), native.checksum(data)))
+        meta = {"fault": 0, **(extra_meta or {})}
     storage.sync()  # blobs durable before the superblock points at them
 
     superblock.checkpoint(VSRState(
@@ -141,8 +147,18 @@ def restore_from_snapshot(
     process: ConfigProcess,
     state: VSRState,
 ) -> None:
-    """Load a checkpoint back into the device ledger (inverse of
+    """Load a checkpoint back into the ledger backend (inverse of
     snapshot_to_superblock; fresh state when the superblock has no blobs)."""
+    if not hasattr(ledger, "state"):  # oracle backend
+        for ref in state.blobs:
+            assert ref.name == "oracle", ref.name
+            raw = storage.read(Zone.grid, ref.offset, ref.size)
+            if native.checksum(raw) != ref.checksum:
+                raise RuntimeError(f"snapshot blob {ref.name}: bad checksum")
+            ledger.restore_bytes(raw)
+        sm.prepare_timestamp = state.prepare_timestamp
+        return
+
     import jax.numpy as jnp
 
     dev = init_state(process)

@@ -110,10 +110,13 @@ class Replica:
 
         # repair state: ops whose prepares we asked peers for
         self._repair_wanted: set[int] = set()
+        # test/simulator observation hook: called on every committed prepare
+        self.commit_hook = None
 
         # tick + view-change state
         self.ticks = 0
         self._primary_contact_tick = 0
+        self._recover_tick = 0
         self._vc_tick = 0
         self._vc_retries = 0
         self.view_candidate = 0
@@ -166,12 +169,36 @@ class Replica:
         while op in recovered:
             header, body = self.journal.read_prepare(op)  # type: ignore
             assert header.parent == self.parent_checksum
-            self._commit_prepare(header, body)
+            if self.replica_count == 1:
+                # Single replica: every journaled op was committed (WAL is
+                # written before execution, and there is no one else).
+                self._commit_prepare(header, body)
+                self.commit_min = self.commit_max = op
+                self.commit_checksum = header.checksum
+            # Multi-replica: the WAL tail is PREPARED, not necessarily
+            # committed — rebuild the log head only; the cluster's commit
+            # numbers (SV / heartbeats) drive execution through
+            # _commit_up_to, and divergent tails get truncated by adoption.
             self.op = op
-            self.parent_checksum = self.commit_checksum = header.checksum
-            self.commit_min = self.commit_max = op
+            self.parent_checksum = header.checksum
             op += 1
-        self.status = "normal"
+        genesis = state.sequence == 1 and self.op == 0
+        if self.replica_count == 1 or genesis:
+            # Cold boot of a fresh cluster (or single replica): view 0 with
+            # replica 0 as primary is the trusted starting point.
+            self.status = "normal"
+        else:
+            # RESTART: our replayed log is only a candidate — we may have
+            # missed commits (torn WAL tail) or whole views. Never resume as
+            # primary on local evidence (reference: status=recovering until
+            # a start_view arrives). Ask the presumed primary for an SV; the
+            # recovering timeout forces a re-election if nobody answers.
+            self.status = "recovering"
+            self._recover_tick = self.ticks
+            rsv = Header(
+                command=int(Command.request_start_view), view=self.view
+            )
+            self._broadcast(rsv)
         self._primary_contact_tick = self.ticks
         # Crashed mid-view-change (view voted > last normal view): resume
         # the view change rather than acting normal in a view we never
@@ -221,16 +248,47 @@ class Replica:
                 if self.ticks % HEARTBEAT_TICKS == 0:
                     h = Header(command=int(Command.commit), commit=self.commit_max)
                     self._broadcast(h)
+                if self.ticks % RETRY_TICKS == 0 and self.pipeline:
+                    # Prepare timeout: retransmit the oldest unacked prepare
+                    # (its broadcast may have been lost; backups re-ack
+                    # duplicates; reference: prepare_timeout).
+                    entry = self.pipeline[min(self.pipeline)]
+                    h, body = entry["header"], entry["body"]
+                    for r in range(self.replica_count):
+                        if r != self.replica and r not in entry["oks"]:
+                            self.network.send(
+                                self.replica, r, h.to_bytes() + body
+                            )
             else:
                 if self.ticks - self._primary_contact_tick > VIEW_CHANGE_TICKS:
                     self._start_view_change(self.view + 1)
             if self.ticks % PING_TICKS == 0:
                 ping = Header(command=int(Command.ping), op=self.time.monotonic())
                 self._broadcast(ping)
+        elif self.status == "recovering":
+            if self.ticks - self._recover_tick > VIEW_CHANGE_TICKS:
+                # Nobody sent a start_view (the cluster may lack a primary):
+                # force a re-election; best-log selection recovers commits.
+                self._start_view_change(self.view + 1)
+            elif self.ticks % RETRY_TICKS == 0:
+                rsv = Header(
+                    command=int(Command.request_start_view), view=self.view
+                )
+                self._broadcast(rsv)
         elif self.status == "view_change":
             if self.ticks - self._vc_tick > RETRY_TICKS:
                 self._vc_retries += 1
-                if self._vc_retries >= 2:
+                if self._adopt is not None and self._vc_retries < 4:
+                    # Mid-adoption: re-request missing fills (lost packets),
+                    # don't abandon the view change while it can progress.
+                    self._vc_tick = self.ticks
+                    self._repair_wanted.clear()
+                    self._request_catchup_window()
+                    for op, h in self._adopt.items():
+                        got = self.journal.read_prepare(op)
+                        if got is None or got[0].checksum != h.checksum:
+                            self._request_prepare(op, self._adopt_src)
+                elif self._vc_retries >= 2:
                     # The candidate view is not completing (its primary may
                     # be down too): escalate to the next view (reference:
                     # view_change_status_timeout increments the view).
@@ -274,6 +332,12 @@ class Replica:
         if cmd == Command.request_prepare:
             self._on_request_prepare(header)
             return
+        if cmd == Command.request_sync_manifest:  # request full checkpoint
+            self._on_request_sync_checkpoint(header)
+            return
+        if cmd == Command.sync_manifest:  # checkpoint (state + trailers)
+            self._on_sync_checkpoint(header, body)
+            return
         if cmd == Command.start_view_change:
             self._on_start_view_change(header)
             return
@@ -287,8 +351,27 @@ class Replica:
             self._on_request_start_view(header)
             return
 
-        if self.status == "view_change" and cmd == Command.prepare:
-            self._on_repair_prepare(header, body)
+        if self.status == "view_change":
+            if header.view > self.view_candidate and cmd in (
+                Command.prepare, Command.commit
+            ):
+                # the cluster moved past our candidate view: catch up via
+                # the authoritative start_view instead of slow escalation
+                rsv = Header(
+                    command=int(Command.request_start_view), view=header.view
+                )
+                self._send(header.view % self.replica_count, rsv)
+                return
+            if cmd == Command.prepare:
+                self._on_repair_prepare(header, body)
+                return
+        if self.status == "recovering":
+            if cmd in (Command.prepare, Command.commit) and header.view >= self.view:
+                # a live primary exists: ask it for the current start_view
+                rsv = Header(
+                    command=int(Command.request_start_view), view=header.view
+                )
+                self._send(header.view % self.replica_count, rsv)
             return
         if self.status != "normal":
             return
@@ -339,6 +422,25 @@ class Replica:
             if entry is not None:
                 if entry["reply"] is not None:
                     self.network.send(self.replica, client, entry["reply"])
+                elif entry["request"] == 0:
+                    # reply bytes were lost across a restart/state sync, but
+                    # the session number IS the stored entry: reconstruct
+                    reply = Header(
+                        command=int(Command.reply),
+                        client=client,
+                        request=0,
+                        op=entry["session"],
+                        commit=entry["session"],
+                        operation=int(Operation.register),
+                    )
+                    body_r = entry["session"].to_bytes(8, "little")
+                    reply.set_checksum_body(body_r)
+                    reply.replica = self.replica
+                    reply.view = self.view
+                    reply.set_checksum()
+                    wire = reply.to_bytes() + body_r
+                    entry["reply"] = wire
+                    self.network.send(self.replica, client, wire)
                 return
         else:
             if entry is None or header.context != entry["session"]:
@@ -359,6 +461,13 @@ class Replica:
                 and h.operation == header.operation
             ):
                 return
+
+        # Pipeline backpressure (reference: pipeline_prepare_queue_max=8):
+        # while commits stall (lost quorum, partition), new requests must
+        # not grow the uncommitted tail without bound — the WAL headroom is
+        # finite. The client retries.
+        if len(self.pipeline) >= self.cluster.pipeline_prepare_queue_max:
+            return
 
         op = self.op + 1
         assert op not in self.pipeline
@@ -418,7 +527,13 @@ class Replica:
                 self.op = header.op
                 self.parent_checksum = header.checksum
                 self._repair_wanted.discard(header.op)
+                self._ack_prepare(header)
                 self._commit_up_to(self.commit_max)  # continues / asks next
+                # drain buffered out-of-order successors (a normal prepare
+                # may have been parked while this gap filled)
+                nxt = self._pending_prepares.pop(self.op + 1, None)
+                if nxt is not None:
+                    self._on_prepare(*nxt)
                 return
             # in-log gap (faulty slot): verified against the expected
             # checksum from the redundant-header mirror
@@ -428,6 +543,17 @@ class Replica:
                     self.journal.write_prepare(header, body)
                 self._repair_wanted.discard(header.op)
                 self._commit_up_to(self.commit_max)
+                return
+            # Unresolvable by point repair: our uncommitted tail above
+            # commit_min is stale (left over from an abandoned view) and the
+            # fill doesn't chain. Re-adopt the whole log via start_view —
+            # adoption truncates to the committed prefix and reverifies.
+            self._repair_wanted.discard(header.op)
+            if self.status == "normal" and not self.is_primary:
+                rsv = Header(
+                    command=int(Command.request_start_view), view=self.view
+                )
+                self._send(self.primary_index, rsv)
             return
         if header.view < self.view or self.is_primary:
             return
@@ -482,6 +608,93 @@ class Replica:
         self.network.send(
             self.replica, header.replica, p_header.to_bytes() + body
         )
+
+    # ------------------------------------------------------------------
+    # state sync: checkpoint shipping for replicas lagging beyond the WAL
+    # (reference: src/vsr/sync.zig — a lagging replica jumps to a newer
+    # checkpoint, then repairs the remaining WAL tail normally)
+    # ------------------------------------------------------------------
+
+    def _on_request_sync_checkpoint(self, header: Header) -> None:
+        state = self.superblock.state
+        if state is None or state.commit_min == 0:
+            return
+        from tigerbeetle_tpu.io.storage import Zone
+
+        payload = state.to_bytes()
+        blob_bytes = b"".join(
+            self.storage.read(Zone.grid, ref.offset, ref.size)
+            for ref in state.blobs
+        )
+        body = len(payload).to_bytes(8, "little") + payload + blob_bytes
+        reply = Header(command=int(Command.sync_manifest))
+        self._send(header.replica, reply, body)
+
+    def _on_sync_checkpoint(self, header: Header, body: bytes) -> None:
+        """Adopt a peer's checkpoint wholesale (we are too far behind for
+        WAL repair). Only while adopting a log whose base our WAL cannot
+        reach."""
+        from tigerbeetle_tpu import native
+        from tigerbeetle_tpu.io.storage import Zone
+        from tigerbeetle_tpu.vsr.superblock import BlobRef, VSRState
+
+        if self.status not in ("view_change", "recovering") or self._adopt is None:
+            return
+        n = int.from_bytes(body[:8], "little")
+        remote = VSRState.from_bytes(body[8 : 8 + n])
+        if remote.commit_min <= self.commit_min:
+            return  # stale / not an improvement
+        blob_raw = body[8 + n :]
+        # verify + rewrite blobs into our own grid (other ping-pong area)
+        own = self.superblock.state
+        assert own is not None
+        area = 1 - own.area
+        area_size = self.storage.layout.sizes[Zone.grid] // 2
+        off = area * area_size
+        local_refs = []
+        pos = 0
+        for ref in remote.blobs:
+            raw = blob_raw[pos : pos + ref.size]
+            pos += ref.size
+            if native.checksum(raw) != ref.checksum:
+                return  # corrupt in flight: retry will refetch
+            self.storage.write(Zone.grid, off, raw)
+            local_refs.append(BlobRef(ref.name, off, ref.size, ref.checksum))
+            off += (len(raw) + 4095) // 4096 * 4096
+        self.storage.sync()
+        meta = dict(remote.meta)
+        # view durability is OURS, not the sync source's
+        meta["view"] = max(
+            int(meta.get("view", 0)), self.view_candidate, self.view
+        )
+        meta["log_view"] = self.log_view
+        new_state = dataclasses.replace(
+            remote,
+            replica=self.replica,
+            sequence=own.sequence + 1,
+            area=area,
+            blobs=local_refs,
+            meta=meta,
+        )
+        self.superblock.checkpoint(new_state)
+        restore_from_snapshot(
+            self.storage, self.ledger, self.sm, self.ledger.process, new_state
+        )
+        self.client_table = {
+            int(c): dict(e, reply=None)
+            for c, e in meta.get("client_table", {}).items()
+        }
+        self.checkpoint_op = new_state.commit_min
+        self.commit_min = self.commit_max = self.op = new_state.commit_min
+        self.parent_checksum = self.commit_checksum = new_state.commit_min_checksum
+        # resume adoption from the new base
+        self._catchup.clear()
+        self._repair_wanted.clear()
+        self._catchup_no_local = True  # local WAL predates the sync point
+        self._vc_tick = self.ticks
+        self._vc_retries = 0
+        self._request_catchup_window()
+        self._try_finish_view_change()
 
     # ------------------------------------------------------------------
     # commit
@@ -543,6 +756,7 @@ class Replica:
             self._commit_prepare(header, body)
             self.commit_min = op
             self.commit_checksum = header.checksum
+            self.pipeline.pop(op, None)  # prune if it was pipelined
 
     def _commit_prepare(self, header: Header, body: bytes) -> bytes | None:
         """Execute one prepare against the replicated state (identical on
@@ -551,6 +765,8 @@ class Replica:
         (reference: src/vsr/client_replies.zig — replies are replicated so
         a post-view-change primary can answer duplicate requests); only the
         primary actually sends it. Returns the reply wire bytes."""
+        if self.commit_hook is not None:
+            self.commit_hook(header, body)
         operation = Operation(header.operation)
         if operation == Operation.register:
             self.client_table[header.client] = {
@@ -598,6 +814,7 @@ class Replica:
         self._svc_votes = {self.replica}
         self._dvc = {}
         self._adopt = None
+        self._catchup = {}
         self.pipeline = {}
         self._pending_prepares = {}
         self._repair_wanted.clear()
@@ -650,6 +867,7 @@ class Replica:
             op=self.commit_min + len(suffix),
             commit=self.commit_min,
             parent=self.commit_checksum,
+            timestamp=self.checkpoint_op,  # my WAL covers (this, op]
         )
         if new_primary == self.replica:
             self._record_dvc(self.replica, dvc, suffix)
@@ -659,10 +877,8 @@ class Replica:
     def _on_do_view_change(self, header: Header, body: bytes) -> None:
         if header.view % self.replica_count != self.replica:
             return
-        if header.view < self.view_candidate or (
-            self.status == "normal" and header.view <= self.view
-        ):
-            return
+        if header.view <= self.view or header.view < self.view_candidate:
+            return  # stale DVC (that view change already completed)
         if self.status != "view_change" or header.view > self.view_candidate:
             self._start_view_change(header.view)
         suffix = [
@@ -686,6 +902,8 @@ class Replica:
                 suffix={h.op: h for h in best_suffix},
                 commit_max=commit_max,
                 src=best_replica,
+                tip=best_h.parent,  # checksum of the op at `base`
+                src_checkpoint=best_h.timestamp,
             )
 
     # -- adoption: two phases shared by the new primary (from DVCs) and
@@ -694,11 +912,16 @@ class Replica:
     # suffix itself, checksum-verified against the adopted headers. --
 
     def _begin_adoption(self, base: int, suffix: dict[int, Header],
-                        commit_max: int, src: int) -> None:
+                        commit_max: int, src: int, tip: int,
+                        src_checkpoint: int = 0) -> None:
         self._adopt = suffix
         self._adopt_base = base
+        self._adopt_tip = tip  # expected checksum of the prepare at `base`
         self._adopt_commit_max = max(commit_max, base)
         self._adopt_src = src
+        self._adopt_src_checkpoint = src_checkpoint
+        self._catchup: dict[int, tuple[Header, bytes]] = {}
+        self._catchup_no_local = False
         # Truncate the log head to the committed prefix: our uncommitted
         # tail may diverge from the chosen log (its journal rows remain and
         # are revalidated by checksum below; the state machine never saw
@@ -706,15 +929,82 @@ class Replica:
         self.op = self.commit_min
         self.parent_checksum = self.commit_checksum
         self._fast_forward(limit=base)
-        if self.op < base and src != self.replica:
-            self._request_prepare(self.op + 1, src)
+        self._verify_catchup_tip()
+        self._request_catchup_window()
         for op, h in suffix.items():
+            if op <= self.commit_min:
+                continue  # our committed prefix already covers it
             got = self.journal.read_prepare(op)
             if got is None or got[0].checksum != h.checksum:
                 if src == self.replica:
                     raise AssertionError("best log is local but unreadable")
                 self._request_prepare(op, src)
         self._try_finish_view_change()
+
+    CATCHUP_WINDOW = 32
+
+    def _request_catchup_window(self) -> None:
+        """Pipeline catch-up fetches (serial round trips would make a long
+        catch-up slower than the view-change timeout — livelock)."""
+        if self._adopt_src == self.replica:
+            return
+        if self.commit_min < self._adopt_src_checkpoint:
+            # Too far behind: the ops we need predate the source's
+            # checkpoint (its WAL ring no longer covers them), and filling
+            # more than a ring's worth would overwrite our own fills — jump
+            # via state sync (checkpoint shipping) instead. commit_min (not
+            # the advancing op) is the stable lag measure: the source's
+            # guard bounds (src_op - src_checkpoint) within one ring, so
+            # once we sync to its checkpoint every remaining fill fits
+            # distinct slots.
+            rq = Header(command=int(Command.request_sync_manifest))
+            self._send(self._adopt_src, rq)
+            return
+        hi = min(self._adopt_base, self.op + self.CATCHUP_WINDOW)
+        for o in range(self.op + 1, hi + 1):
+            if o not in self._repair_wanted and o not in self._catchup:
+                self._request_prepare(o, self._adopt_src)
+
+    def _verify_catchup_tip(self) -> None:
+        """Our LOCAL chain up to the suffix base may include prepares the
+        cluster discarded (we were the old primary) — locally consistent
+        but wrong. The DVC/SV carries the true checksum of the op at the
+        base (`tip`); on mismatch, restart catch-up from the committed
+        prefix fetching everything from the source (remote fills overwrite
+        the stale rows and are chain-verified from commit_checksum)."""
+        if (
+            self.op < self._adopt_base
+            or self._adopt_base == 0
+            or self._adopt_base <= self.commit_min  # we're at/ahead of base:
+            # our committed prefix subsumes it (quorum intersection)
+        ):
+            return
+        if self.parent_checksum != self._adopt_tip:
+            self._catchup_no_local = True
+            self.op = self.commit_min
+            self.parent_checksum = self.commit_checksum
+            self._repair_wanted.clear()
+            self._catchup.clear()
+
+    def _drain_catchup(self) -> None:
+        while self.op < self._adopt_base:
+            if not self._catchup_no_local:
+                self._fast_forward(limit=self._adopt_base)
+                self._verify_catchup_tip()
+            got = self._catchup.pop(self.op + 1, None)
+            if got is None:
+                break
+            header, body = got
+            if header.parent != self.parent_checksum:
+                # stale/wrong fill: re-request
+                self._repair_wanted.discard(header.op)
+                self._request_prepare(header.op, self._adopt_src)
+                break
+            self.journal.write_prepare(header, body)
+            self.op = header.op
+            self.parent_checksum = header.checksum
+        if self.op >= self._adopt_base:
+            self._verify_catchup_tip()
 
     def _fast_forward(self, limit: int) -> None:
         """Advance the log head through locally-journaled ops that chain
@@ -728,21 +1018,20 @@ class Replica:
 
     def _on_repair_prepare(self, header: Header, body: bytes) -> None:
         """A prepare arriving while in view_change: either a chain catch-up
-        fill below the suffix base or an adopted suffix prepare."""
+        fill below the suffix base or an adopted suffix prepare. Any
+        accepted fill counts as view-change progress (resets the retry/
+        escalation timer — a long catch-up must not be abandoned)."""
         if self._adopt is None:
             return
-        if (
-            header.op == self.op + 1
-            and header.op <= self._adopt_base
-            and header.parent == self.parent_checksum
-        ):
-            self.journal.write_prepare(header, body)
-            self.op = header.op
-            self.parent_checksum = header.checksum
+        if header.op <= self._adopt_base:
+            if header.op <= self.op:
+                return  # already have it
             self._repair_wanted.discard(header.op)
-            self._fast_forward(limit=self._adopt_base)
-            if self.op < self._adopt_base:
-                self._request_prepare(self.op + 1, self._adopt_src)
+            self._catchup[header.op] = (header, body)
+            self._vc_tick = self.ticks
+            self._vc_retries = 0
+            self._drain_catchup()
+            self._request_catchup_window()
             self._try_finish_view_change()
             return
         want = self._adopt.get(header.op)
@@ -750,13 +1039,24 @@ class Replica:
             return
         self.journal.write_prepare(header, body)
         self._repair_wanted.discard(header.op)
+        self._vc_tick = self.ticks
+        self._vc_retries = 0
         self._try_finish_view_change()
 
     def _adoption_complete(self) -> bool:
         assert self._adopt is not None
-        if self.op < self._adopt_base:
+        anchor = max(self.commit_min, self._adopt_base)
+        if self.op < anchor:
             return False  # catch-up still in flight
+        if (
+            self._adopt_base > self.commit_min
+            and self._adopt_base > 0
+            and self.parent_checksum != self._adopt_tip
+        ):
+            return False  # local tail was stale; refetch in flight
         for op, h in self._adopt.items():
+            if op <= self.commit_min:
+                continue  # already committed; consistent by quorum math
             got = self.journal.read_prepare(op)
             if got is None or got[0].checksum != h.checksum:
                 return False
@@ -773,15 +1073,18 @@ class Replica:
 
     def _finish_view_change(self, primary: bool) -> None:
         assert self._adopt is not None
-        ops = sorted(self._adopt)
-        base = self._adopt_base
-        assert self.op >= base
+        # The adopted log head: suffix ops above our committed prefix win;
+        # otherwise whichever of (base, commit_min) is further.
+        ops = sorted(o for o in self._adopt if o > self.commit_min)
         if ops:
             self.op = ops[-1]
             self.parent_checksum = self._adopt[ops[-1]].checksum
+        elif self._adopt_base > self.commit_min:
+            self.op = self._adopt_base
+            self.parent_checksum = self._adopt_tip
         else:
-            self.op = base
-            self.parent_checksum = self._checksum_of(base)
+            self.op = self.commit_min
+            self.parent_checksum = self.commit_checksum
         self.view = self.view_candidate
         self.log_view = self.view
         persist_view(self.superblock, self.view, self.log_view)
@@ -798,11 +1101,15 @@ class Replica:
                 view=self.view,
                 op=self.op,
                 commit=self.commit_min,
+                parent=self.commit_checksum,  # checksum of op `commit`
+                timestamp=self.checkpoint_op,  # my WAL covers (this, op]
             )
             self._broadcast(sv, b"".join(h.to_bytes() for h in suffix))
-            # Surviving uncommitted suffix ops re-enter the pipeline;
-            # backups re-ack them from their adopted SV suffix and quorum
-            # recommits them in the new view (commits survive view changes).
+            # Commit the known-committed prefix FIRST, then refill the
+            # pipeline with only the still-uncommitted tail (a stale
+            # committed entry would poison retransmission and quorum
+            # counting).
+            self._commit_up_to(adopt_commit_max)
             for op in range(self.commit_min + 1, self.op + 1):
                 got = self.journal.read_prepare(op)
                 assert got is not None
@@ -810,7 +1117,6 @@ class Replica:
                 self.pipeline[op] = {
                     "header": h, "body": body, "oks": {self.replica}
                 }
-            self._commit_up_to(adopt_commit_max)
         else:
             self._commit_up_to(adopt_commit_max)
             # Re-ack the adopted-but-uncommitted tail so the new primary
@@ -820,20 +1126,14 @@ class Replica:
                 if got is not None:
                     self._ack_prepare(got[0])
 
-    def _checksum_of(self, op: int) -> int:
-        if op == 0:
-            return 0
-        if op == self.commit_min:
-            return self.commit_checksum
-        got = self.journal.read_prepare(op)
-        assert got is not None
-        return got[0].checksum
-
     def _on_start_view(self, header: Header, body: bytes) -> None:
-        if header.view < self.view or (
-            header.view == self.view and self.status == "normal"
-        ):
+        if header.view < self.view:
             return
+        if header.view == self.view and (
+            self.is_primary or header.replica != self.primary_index
+        ):
+            return  # same-view SV only from the view's primary (requested
+            # re-adoption: a backup with a stale tail asks for one)
         suffix = [
             Header.from_bytes(body[i : i + HEADER_SIZE])
             for i in range(0, len(body), HEADER_SIZE)
@@ -843,16 +1143,22 @@ class Replica:
         self.pipeline = {}
         self._pending_prepares = {}
         self._repair_wanted.clear()
+        self._vc_tick = self.ticks  # fresh adoption: reset retry state so
+        self._vc_retries = 0  # stale counters can't abandon it instantly
         persist_view(self.superblock, header.view, self.log_view)
         self._begin_adoption(
             base=header.commit,
             suffix={h.op: h for h in suffix},
             commit_max=header.commit,
             src=header.replica,
+            tip=header.parent,
+            src_checkpoint=header.timestamp,
         )
 
     def _on_request_start_view(self, header: Header) -> None:
-        if not self.is_primary or header.view != self.view:
+        # Serve any requester at or below our view (a recovering/stale
+        # replica catches up from the authoritative current SV).
+        if not self.is_primary or header.view > self.view:
             return
         suffix = self._suffix_headers()
         sv = Header(
@@ -860,6 +1166,8 @@ class Replica:
             view=self.view,
             op=self.op,
             commit=self.commit_min,
+            parent=self.commit_checksum,  # checksum of op `commit`
+            timestamp=self.checkpoint_op,  # my WAL covers (this, op]
         )
         self._send(
             header.replica, sv, b"".join(h.to_bytes() for h in suffix)
