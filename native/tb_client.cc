@@ -1,0 +1,304 @@
+// tb_client: the C-ABI client library over the TCP message bus.
+//
+// TPU-native counterpart of the reference's tb_client (reference:
+// src/clients/c/tb_client.zig:8-27): a C interface any language can bind
+// (the Python binding is tigerbeetle_tpu/client_ffi.py; see
+// native/tb_client.h for the header). Protocol: 128-byte VSR headers with
+// AEGIS-128L dual checksums (aegis.cc, same shared library), a register
+// round trip establishing the session, then one in-flight request at a
+// time with monotonically increasing request numbers — the reference
+// client's session discipline (reference: src/vsr/client.zig:17-80).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+extern "C" void tb_checksum(const uint8_t *data, uint64_t len, uint8_t out[16]);
+
+namespace {
+
+constexpr uint64_t HEADER_SIZE = 128;
+constexpr uint64_t MESSAGE_SIZE_MAX = 1 << 20;
+
+// header field offsets (tigerbeetle_tpu/vsr/header.py HEADER_DTYPE)
+constexpr int OFF_CHECKSUM = 0;
+constexpr int OFF_CHECKSUM_BODY = 16;
+constexpr int OFF_CLIENT = 48;
+constexpr int OFF_CONTEXT = 64;
+constexpr int OFF_REQUEST = 80;
+constexpr int OFF_CLUSTER = 84;
+constexpr int OFF_OP = 96;
+constexpr int OFF_TIMESTAMP = 112;
+constexpr int OFF_SIZE = 120;
+constexpr int OFF_COMMAND = 125;
+constexpr int OFF_OPERATION = 126;
+
+constexpr uint8_t COMMAND_REQUEST = 5;
+constexpr uint8_t COMMAND_REPLY = 8;
+constexpr uint8_t COMMAND_EVICTION = 18;
+constexpr uint8_t OPERATION_REGISTER = 2;
+
+int read_exact(int fd, uint8_t *buf, uint64_t len) {
+  uint64_t done = 0;
+  while (done < len) {
+    ssize_t n = read(fd, buf + done, len - done);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (n == 0) return -ECONNRESET;
+    done += (uint64_t)n;
+  }
+  return 0;
+}
+
+int write_all(int fd, const uint8_t *buf, uint64_t len) {
+  uint64_t done = 0;
+  while (done < len) {
+    ssize_t n = write(fd, buf + done, len - done);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    done += (uint64_t)n;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+constexpr int ADDRS_MAX = 8;
+
+struct tb_client {
+  int fd;
+  uint8_t client_id[16];
+  uint64_t session;
+  uint32_t request_number;
+  uint32_t cluster;
+  // The cluster's addresses: the client rotates to the next replica when a
+  // request times out (it may be talking to a non-primary after a view
+  // change; duplicate resends are answered from the replicated session
+  // table, so rotation is idempotent). The reference client learns views
+  // from pings instead — rotation is the blocking-client equivalent.
+  char hosts[ADDRS_MAX][64];
+  int ports[ADDRS_MAX];
+  int addr_count;
+  int addr_current;
+};
+
+// Build + send one request and block for its reply body.
+static int submit(tb_client *c, uint8_t operation, uint32_t request_number,
+                  const void *body, uint64_t body_len, void *reply,
+                  uint64_t reply_cap, uint64_t *reply_len) {
+  if (HEADER_SIZE + body_len > MESSAGE_SIZE_MAX) return -EMSGSIZE;
+  uint8_t h[HEADER_SIZE];
+  memset(h, 0, sizeof(h));
+  memcpy(h + OFF_CLIENT, c->client_id, 16);
+  uint64_t session = c->session;
+  memcpy(h + OFF_CONTEXT, &session, 8);
+  memcpy(h + OFF_REQUEST, &request_number, 4);
+  memcpy(h + OFF_CLUSTER, &c->cluster, 4);
+  uint32_t size = (uint32_t)(HEADER_SIZE + body_len);
+  memcpy(h + OFF_SIZE, &size, 4);
+  h[OFF_COMMAND] = COMMAND_REQUEST;
+  h[OFF_OPERATION] = operation;
+  tb_checksum((const uint8_t *)body, body_len, h + OFF_CHECKSUM_BODY);
+  tb_checksum(h + 16, HEADER_SIZE - 16, h + OFF_CHECKSUM);
+
+  int rc = write_all(c->fd, h, HEADER_SIZE);
+  if (rc != 0) return rc;
+  if (body_len) {
+    rc = write_all(c->fd, (const uint8_t *)body, body_len);
+    if (rc != 0) return rc;
+  }
+
+  // Await the matching reply (ignore anything else).
+  for (;;) {
+    uint8_t rh[HEADER_SIZE];
+    rc = read_exact(c->fd, rh, HEADER_SIZE);
+    if (rc != 0) return rc;
+    uint32_t rsize;
+    memcpy(&rsize, rh + OFF_SIZE, 4);
+    if (rsize < HEADER_SIZE || rsize > MESSAGE_SIZE_MAX) return -EBADMSG;
+    uint64_t blen = rsize - HEADER_SIZE;
+    uint8_t *rbody = (uint8_t *)malloc(blen ? blen : 1);
+    if (!rbody) return -ENOMEM;
+    rc = read_exact(c->fd, rbody, blen);
+    if (rc != 0) {
+      free(rbody);
+      return rc;
+    }
+    // checksum gate (header covered by [16,128); body by checksum_body)
+    uint8_t want[16];
+    tb_checksum(rh + 16, HEADER_SIZE - 16, want);
+    if (memcmp(want, rh + OFF_CHECKSUM, 16) != 0) {
+      free(rbody);
+      continue;  // corrupt frame: skip
+    }
+    tb_checksum(rbody, blen, want);
+    if (memcmp(want, rh + OFF_CHECKSUM_BODY, 16) != 0) {
+      free(rbody);
+      continue;
+    }
+    if (rh[OFF_COMMAND] == COMMAND_EVICTION) {
+      free(rbody);
+      return -ESTALE;  // session evicted
+    }
+    uint32_t rreq;
+    memcpy(&rreq, rh + OFF_REQUEST, 4);
+    if (rh[OFF_COMMAND] != COMMAND_REPLY || rreq != request_number) {
+      free(rbody);
+      continue;  // stale reply
+    }
+    if (blen > reply_cap) {
+      free(rbody);
+      return -ENOSPC;
+    }
+    memcpy(reply, rbody, blen);
+    *reply_len = blen;
+    free(rbody);
+    return 0;
+  }
+}
+
+static int connect_current(tb_client *c) {
+  if (c->fd >= 0) {
+    close(c->fd);
+    c->fd = -1;
+  }
+  struct addrinfo hints, *res = nullptr;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  snprintf(portbuf, sizeof(portbuf), "%d", c->ports[c->addr_current]);
+  if (getaddrinfo(c->hosts[c->addr_current], portbuf, &hints, &res) != 0 ||
+      !res) {
+    return -EHOSTUNREACH;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    int e = errno;
+    if (fd >= 0) close(fd);
+    freeaddrinfo(res);
+    return -(e ? e : EHOSTUNREACH);
+  }
+  freeaddrinfo(res);
+  // Per-try timeout: long enough for first-commit jit compiles on a loaded
+  // host, short enough that rotating to the real primary converges.
+  struct timeval tv = {30, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  c->fd = fd;
+  return 0;
+}
+
+// Submit with rotation: on timeout/reset, reconnect to the next replica and
+// resend (duplicates are answered from the replicated session table).
+static int submit_rotating(tb_client *c, uint8_t operation,
+                           uint32_t request_number, const void *body,
+                           uint64_t body_len, void *reply, uint64_t reply_cap,
+                           uint64_t *reply_len) {
+  int tries = c->addr_count * 6;
+  int rc = -EHOSTUNREACH;
+  for (int i = 0; i < tries; i++) {
+    if (c->fd < 0) {
+      rc = connect_current(c);
+      if (rc != 0) {
+        c->addr_current = (c->addr_current + 1) % c->addr_count;
+        continue;
+      }
+    }
+    rc = submit(c, operation, request_number, body, body_len, reply,
+                reply_cap, reply_len);
+    if (rc == 0 || rc == -ESTALE || rc == -ENOSPC || rc == -EMSGSIZE) {
+      return rc;
+    }
+    // timeout / reset: rotate to the next replica
+    close(c->fd);
+    c->fd = -1;
+    c->addr_current = (c->addr_current + 1) % c->addr_count;
+  }
+  return rc;
+}
+
+int tb_client_init(tb_client **out, const char *addresses, int port_unused,
+                   uint32_t cluster, const uint8_t client_id[16]) {
+  (void)port_unused;
+  tb_client *c = (tb_client *)calloc(1, sizeof(tb_client));
+  if (!c) return -ENOMEM;
+  c->fd = -1;
+  memcpy(c->client_id, client_id, 16);
+  c->cluster = cluster;
+
+  // parse "host:port[,host:port...]"
+  const char *p = addresses;
+  while (*p && c->addr_count < ADDRS_MAX) {
+    const char *comma = strchr(p, ',');
+    const char *end = comma ? comma : p + strlen(p);
+    const char *colon = nullptr;
+    for (const char *q = p; q < end; q++)
+      if (*q == ':') colon = q;
+    if (!colon) {
+      free(c);
+      return -EINVAL;
+    }
+    size_t hlen = (size_t)(colon - p);
+    if (hlen == 0 || hlen >= sizeof(c->hosts[0])) {
+      free(c);
+      return -EINVAL;
+    }
+    memcpy(c->hosts[c->addr_count], p, hlen);
+    c->hosts[c->addr_count][hlen] = 0;
+    c->ports[c->addr_count] = atoi(colon + 1);
+    c->addr_count++;
+    p = comma ? comma + 1 : end;
+  }
+  if (c->addr_count == 0) {
+    free(c);
+    return -EINVAL;
+  }
+
+  // register the session (request 0, empty body)
+  uint8_t session_buf[8];
+  uint64_t n = 0;
+  int rc = submit_rotating(c, OPERATION_REGISTER, 0, nullptr, 0, session_buf,
+                           sizeof(session_buf), &n);
+  if (rc != 0 || n < 8) {
+    if (c->fd >= 0) close(c->fd);
+    free(c);
+    return rc != 0 ? rc : -EBADMSG;
+  }
+  memcpy(&c->session, session_buf, 8);
+  c->request_number = 0;
+  *out = c;
+  return 0;
+}
+
+int tb_client_request(tb_client *c, uint8_t operation, const void *body,
+                      uint64_t body_len, void *reply, uint64_t reply_cap,
+                      uint64_t *reply_len) {
+  if (c->session == 0) return -ESTALE;
+  c->request_number += 1;
+  return submit_rotating(c, operation, c->request_number, body, body_len,
+                         reply, reply_cap, reply_len);
+}
+
+void tb_client_deinit(tb_client *c) {
+  if (!c) return;
+  if (c->fd >= 0) close(c->fd);
+  free(c);
+}
+
+}  // extern "C"

@@ -1,0 +1,240 @@
+"""Real-process integration (reference: src/testing/tmp_tigerbeetle.zig +
+client integration tests): spawn the server binary, drive it over real TCP
+with the native C client and the REPL, kill it, restart it, verify
+durability."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tigerbeetle_tpu.types import (
+    Account,
+    CreateTransferResult,
+    Transfer,
+    TransferFlags,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_server(path: str, port: int, aof: str | None = None):
+    cmd = [
+        sys.executable, "-m", "tigerbeetle_tpu", "start",
+        "--addresses", f"127.0.0.1:{port}",
+        "--grid-mb", "8",
+        "--account-slots-log2", "10",
+        "--transfer-slots-log2", "12",
+    ]
+    if aof:
+        cmd += ["--aof", aof]
+    cmd.append(path)
+    env = dict(os.environ, TB_JAX_PLATFORM="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    line = proc.stdout.readline()  # blocks until "listening" (or crash)
+    if "listening" not in line:
+        rest = proc.stdout.read()
+        proc.kill()
+        raise AssertionError(f"server failed to start: {line}{rest}")
+    return proc
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("proc")
+    path = str(tmp / "data.tigerbeetle")
+    aof = str(tmp / "data.aof")
+    port = _free_port()
+    fmt = subprocess.run(
+        [sys.executable, "-m", "tigerbeetle_tpu", "format",
+         "--cluster", "0", "--replica", "0", "--replica-count", "1",
+         "--grid-mb", "8", path],
+        cwd=REPO, env=dict(os.environ, PYTHONPATH=REPO),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert fmt.returncode == 0, fmt.stderr
+    proc = _spawn_server(path, port, aof=aof)
+    yield {"proc": proc, "path": path, "port": port, "aof": aof}
+    if proc.poll() is None:
+        proc.kill()
+
+
+def test_native_client_end_to_end(server):
+    from tigerbeetle_tpu.client_ffi import NativeClient
+
+    client = NativeClient("127.0.0.1", server["port"])
+    assert client.create_accounts(
+        [Account(id=i, ledger=1, code=1) for i in (1, 2, 3)]
+    ) == []
+    results = client.create_transfers([
+        Transfer(id=10, debit_account_id=1, credit_account_id=2, amount=100,
+                 ledger=1, code=1),
+        Transfer(id=11, debit_account_id=1, credit_account_id=3, amount=50,
+                 ledger=1, code=1, flags=int(TransferFlags.pending)),
+        Transfer(id=12, pending_id=11,
+                 flags=int(TransferFlags.post_pending_transfer)),
+        Transfer(id=13, debit_account_id=1, credit_account_id=1, amount=5,
+                 ledger=1, code=1),
+    ])
+    assert results == [(3, int(CreateTransferResult.accounts_must_be_different))]
+    accounts = client.lookup_accounts([1, 2, 3, 404])
+    assert len(accounts) == 3
+    assert accounts[0].debits_posted == 150 and accounts[0].debits_pending == 0
+    transfers = client.lookup_transfers([12])
+    assert transfers[0].amount == 50 and transfers[0].pending_id == 11
+    client.close()
+
+
+def test_repl_against_live_server(server):
+    import io
+
+    from tigerbeetle_tpu.repl import Repl, parse_statement
+    from tigerbeetle_tpu.types import Operation
+
+    op, events = parse_statement(
+        "create_transfers id=77 debit_account_id=2 credit_account_id=3 "
+        "amount=7 ledger=1 code=1;"
+    )
+    assert op == Operation.create_transfers and events[0].amount == 7
+
+    repl = Repl([("127.0.0.1", server["port"])])
+    repl.connect()
+    out = repl.execute(*parse_statement(
+        "create_accounts id=500 ledger=1 code=9;"
+    ))
+    assert out == "ok"
+    out = repl.execute(*parse_statement("lookup_accounts id=500;"))
+    assert "id=500" in out and "code=9" in out
+    out = repl.execute(*parse_statement("create_accounts id=500 ledger=1 code=8;"))
+    assert "exists_with_different_code" in out
+
+
+def test_three_replica_tcp_cluster(tmp_path):
+    """Three real server processes over real sockets: consensus across
+    OS process boundaries, driven by the native C client."""
+    from tigerbeetle_tpu.client_ffi import NativeClient
+
+    ports = [_free_port() for _ in range(3)]
+    addresses = ",".join(f"127.0.0.1:{p}" for p in ports)
+    procs = []
+    try:
+        for i in range(3):
+            path = str(tmp_path / f"r{i}.tigerbeetle")
+            fmt = subprocess.run(
+                [sys.executable, "-m", "tigerbeetle_tpu", "format",
+                 "--cluster", "0", "--replica", str(i),
+                 "--replica-count", "3", "--grid-mb", "8", path],
+                cwd=REPO, env=dict(os.environ, PYTHONPATH=REPO),
+                capture_output=True, text=True, timeout=120,
+            )
+            assert fmt.returncode == 0, fmt.stderr
+        for i in range(3):
+            cmd = [
+                sys.executable, "-m", "tigerbeetle_tpu", "start",
+                "--addresses", addresses, "--replica", str(i),
+                "--grid-mb", "8", "--account-slots-log2", "10",
+                "--transfer-slots-log2", "12",
+                str(tmp_path / f"r{i}.tigerbeetle"),
+            ]
+            env = dict(os.environ, TB_JAX_PLATFORM="cpu", PYTHONPATH=REPO)
+            p = subprocess.Popen(cmd, cwd=REPO, env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+            line = p.stdout.readline()
+            assert "listening" in line, line + (p.stdout.read() or "")
+            procs.append(p)
+
+        client = NativeClient(addresses)  # rotates to find the primary
+        assert client.create_accounts(
+            [Account(id=i, ledger=1, code=1) for i in (1, 2)]
+        ) == []
+        assert client.create_transfers([
+            Transfer(id=10, debit_account_id=1, credit_account_id=2,
+                     amount=42, ledger=1, code=1)
+        ]) == []
+        accounts = client.lookup_accounts([1, 2])
+        assert accounts[0].debits_posted == 42
+        assert accounts[1].credits_posted == 42
+        client.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_statsd_and_tracer_units(tmp_path):
+    import json as _json
+    import socket as _socket
+
+    from tigerbeetle_tpu.statsd import StatsD
+    from tigerbeetle_tpu.tracer import JsonTracer, Tracer
+
+    # statsd: packets really hit the wire in the documented format
+    sink = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    sink.bind(("127.0.0.1", 0))
+    sink.settimeout(2)
+    port = sink.getsockname()[1]
+    s = StatsD("127.0.0.1", port, prefix="tb")
+    s.count("ops", 3)
+    s.gauge("commit", 17)
+    s.timing("batch", 1.5)
+    got = {sink.recv(256).decode() for _ in range(3)}
+    assert got == {"tb.ops:3|c", "tb.commit:17|g", "tb.batch:1.5|ms"}
+    s.close()
+    sink.close()
+
+    # tracer: spans nest and dump as Chrome trace events
+    tr = JsonTracer()
+    with tr.span("commit", op=7):
+        with tr.span("prefetch"):
+            pass
+    path = str(tmp_path / "trace.json")
+    tr.dump(path)
+    events = _json.load(open(path))["traceEvents"]
+    assert {e["name"] for e in events} == {"commit", "prefetch"}
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+    # the none backend is a no-op
+    with Tracer().span("x"):
+        pass
+
+
+def test_kill_restart_durability_and_aof(server):
+    from tigerbeetle_tpu import aof as aof_mod
+    from tigerbeetle_tpu.client_ffi import NativeClient
+    from tigerbeetle_tpu.types import Operation
+
+    proc = server["proc"]
+    proc.send_signal(signal.SIGKILL)  # hard kill, no cleanup
+    proc.wait(timeout=30)
+
+    # AOF alone can reconstruct the committed history
+    ops = list(aof_mod.replay(server["aof"]))
+    assert len(ops) >= 3
+    assert {Operation(h.operation) for h, _ in ops} >= {
+        Operation.create_accounts, Operation.create_transfers
+    }
+
+    proc2 = _spawn_server(server["path"], server["port"], aof=server["aof"] + "2")
+    server["proc"] = proc2
+    client = NativeClient("127.0.0.1", server["port"])
+    accounts = client.lookup_accounts([1, 500])
+    assert accounts[0].debits_posted == 150  # survived the kill
+    assert accounts[1].code == 9
+    # and the restarted server still serves writes
+    assert client.create_accounts([Account(id=600, ledger=1, code=1)]) == []
+    client.close()

@@ -1,0 +1,171 @@
+"""The process CLI: format | start | version | client | repl.
+
+The reference's surface (reference: src/tigerbeetle/main.zig:26-33
+composition root, src/tigerbeetle/cli.zig:54-116 flags):
+
+  python -m tigerbeetle_tpu format --cluster=0 --replica=0 \
+      --replica-count=1 data.tigerbeetle
+  python -m tigerbeetle_tpu start --addresses=127.0.0.1:3001 [--aof=f] \
+      data.tigerbeetle
+  python -m tigerbeetle_tpu version
+  python -m tigerbeetle_tpu repl --addresses=...
+
+`start` is the composition root: FileStorage + TCPMessageBus + RealTime
+injected into the Replica, then the event loop (bus pump + replica ticks at
+tick_ms; reference: main.zig start loop).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+VERSION = "0.2.0"
+
+
+def _parse_addresses(s: str) -> list[tuple[str, int]]:
+    out = []
+    for part in s.split(","):
+        host, _, port = part.strip().rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+def _storage(path: str, cluster_cfg, create: bool, grid_mb: int):
+    from tigerbeetle_tpu.io.storage import FileStorage, ZoneLayout
+
+    layout = ZoneLayout(cluster_cfg, grid_size=grid_mb * 1024 * 1024)
+    return FileStorage(path, layout, create=create)
+
+
+def cmd_format(args) -> int:
+    from tigerbeetle_tpu.constants import ConfigCluster
+    from tigerbeetle_tpu.vsr.durable import format_data_file
+
+    cluster_cfg = ConfigCluster(replica_count=args.replica_count)
+    storage = _storage(args.file, cluster_cfg, create=True, grid_mb=args.grid_mb)
+    format_data_file(
+        storage, cluster_cfg, cluster_id=args.cluster, replica=args.replica
+    )
+    storage.close()
+    print(f"formatted {args.file}: cluster={args.cluster} "
+          f"replica={args.replica}/{args.replica_count}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    import os
+
+    plat = os.environ.get("TB_JAX_PLATFORM")
+    if plat:  # tests pin the CPU backend for spawned servers
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    from tigerbeetle_tpu.aof import AOF
+    from tigerbeetle_tpu.constants import ConfigCluster, ConfigProcess
+    from tigerbeetle_tpu.io.message_bus import TCPMessageBus
+    from tigerbeetle_tpu.io.time import RealTime
+    from tigerbeetle_tpu.statsd import StatsD
+    from tigerbeetle_tpu.vsr.replica import Replica
+
+    addresses = _parse_addresses(args.addresses)
+    cluster_cfg = ConfigCluster(replica_count=len(addresses))
+    process_cfg = ConfigProcess(
+        account_slots_log2=args.account_slots_log2,
+        transfer_slots_log2=args.transfer_slots_log2,
+    )
+    storage = _storage(args.file, cluster_cfg, create=False, grid_mb=args.grid_mb)
+    bus = TCPMessageBus(addresses, args.replica, listen=True)
+    replica = Replica(
+        args.replica, len(addresses), storage, bus, RealTime(),
+        cluster_cfg, process_cfg,
+    )
+    if args.aof:
+        replica.aof = AOF(args.aof)
+    statsd = None
+    if args.statsd:
+        host, _, port = args.statsd.rpartition(":")
+        statsd = StatsD(host or "127.0.0.1", int(port))
+    replica.open()
+    print(
+        f"replica {args.replica}/{len(addresses)} listening on "
+        f"{addresses[args.replica][0]}:{addresses[args.replica][1]} "
+        f"(op={replica.op}, commit={replica.commit_min})",
+        flush=True,
+    )
+    debug = bool(os.environ.get("TB_DEBUG"))
+    tick_s = process_cfg.tick_ms / 1000.0
+    last_tick = time.monotonic()
+    last_debug = time.monotonic()
+    last_commit = replica.commit_min
+    while True:
+        bus.pump(timeout=tick_s)
+        now = time.monotonic()
+        if now - last_tick >= tick_s:
+            last_tick = now
+            replica.tick()
+            if statsd is not None and replica.commit_min != last_commit:
+                statsd.count("ops_committed", replica.commit_min - last_commit)
+                statsd.gauge("commit_min", replica.commit_min)
+                last_commit = replica.commit_min
+        if debug and now - last_debug >= 1.0:
+            last_debug = now
+            print(
+                f"[debug] status={replica.status} view={replica.view} "
+                f"op={replica.op} commit={replica.commit_min} "
+                f"pipeline={sorted(replica.pipeline)} "
+                f"wanted={sorted(replica._repair_wanted)} "
+                f"conns={sorted(str(k) if k < 1000 else 'client' for k in bus.conns)}",
+                flush=True,
+            )
+
+
+def cmd_repl(args) -> int:
+    from tigerbeetle_tpu.repl import Repl
+
+    addresses = _parse_addresses(args.addresses)
+    repl = Repl(addresses, cluster_id=args.cluster)
+    return repl.run(sys.stdin, echo=not sys.stdin.isatty())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tigerbeetle_tpu")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("format", help="create a fresh data file")
+    p.add_argument("--cluster", type=int, default=0)
+    p.add_argument("--replica", type=int, default=0)
+    p.add_argument("--replica-count", type=int, default=1)
+    p.add_argument("--grid-mb", type=int, default=64)
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_format)
+
+    p = sub.add_parser("start", help="run a replica")
+    p.add_argument("--addresses", required=True,
+                   help="comma-separated host:port per replica")
+    p.add_argument("--replica", type=int, default=0)
+    p.add_argument("--grid-mb", type=int, default=64)
+    p.add_argument("--account-slots-log2", type=int, default=20)
+    p.add_argument("--transfer-slots-log2", type=int, default=24)
+    p.add_argument("--aof", help="append-only disaster-recovery log path")
+    p.add_argument("--statsd", help="statsd host:port")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("version")
+    p.set_defaults(fn=lambda a: print(f"tigerbeetle_tpu {VERSION}") or 0)
+
+    p = sub.add_parser("repl", help="interactive client",
+                       aliases=["client"])
+    p.add_argument("--addresses", required=True)
+    p.add_argument("--cluster", type=int, default=0)
+    p.set_defaults(fn=cmd_repl)
+
+    args = ap.parse_args(argv)
+    return args.fn(args) or 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

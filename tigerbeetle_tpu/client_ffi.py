@@ -1,0 +1,107 @@
+"""Python binding over the native C-ABI client (native/tb_client.cc).
+
+The pattern the reference uses for all language bindings — one native
+client library, typed wrappers per language (reference: src/clients/go,
+java, dotnet, node over src/clients/c/tb_client.zig). This is the Python
+instance: ctypes over tb_client.h, exposing typed Account/Transfer calls.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from tigerbeetle_tpu import native, types
+from tigerbeetle_tpu.state_machine import decode_results, encode_ids
+from tigerbeetle_tpu.types import Operation
+
+MESSAGE_BODY_MAX = (1 << 20) - 128
+
+
+class _TBClientHandle(ctypes.Structure):
+    pass
+
+
+def _lib():
+    l = native.lib()  # builds/loads libtb_native.so (shared with checksum/io)
+    if not hasattr(l, "_tb_client_bound"):
+        l.tb_client_init.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(_TBClientHandle)),
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32, ctypes.c_char_p,
+        ]
+        l.tb_client_init.restype = ctypes.c_int
+        l.tb_client_request.argtypes = [
+            ctypes.POINTER(_TBClientHandle), ctypes.c_uint8, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        l.tb_client_request.restype = ctypes.c_int
+        l.tb_client_deinit.argtypes = [ctypes.POINTER(_TBClientHandle)]
+        l.tb_client_deinit.restype = None
+        l._tb_client_bound = True
+    return l
+
+
+class NativeClient:
+    """A registered session against a running cluster, via the native lib."""
+
+    def __init__(self, host: str, port: int = 0, cluster: int = 0,
+                 client_id: bytes | None = None):
+        """host: one "host" (with port arg) or a full address list
+        "host:port[,host:port...]" — the client rotates across replicas."""
+        self._lib = _lib()
+        self._handle = ctypes.POINTER(_TBClientHandle)()
+        cid = client_id or os.urandom(15) + b"\x01"  # nonzero u128
+        addresses = host if ":" in host else f"{host}:{port}"
+        rc = self._lib.tb_client_init(
+            ctypes.byref(self._handle), addresses.encode(), 0, cluster, cid
+        )
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc), addresses)
+        # reply buffer reused across requests (single in-flight by design)
+        self._reply_buf = ctypes.create_string_buffer(MESSAGE_BODY_MAX)
+
+    def _request(self, operation: Operation, body: bytes) -> bytes:
+        out = self._reply_buf
+        out_len = ctypes.c_uint64(0)
+        rc = self._lib.tb_client_request(
+            self._handle, int(operation), body, len(body), out,
+            MESSAGE_BODY_MAX, ctypes.byref(out_len),
+        )
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc), operation.name)
+        return out.raw[: out_len.value]
+
+    # -- typed API (the binding surface) --
+
+    def create_accounts(self, accounts: list[types.Account]):
+        reply = self._request(
+            Operation.create_accounts, types.accounts_to_np(accounts).tobytes()
+        )
+        return decode_results(reply, Operation.create_accounts)
+
+    def create_transfers(self, transfers: list[types.Transfer]):
+        reply = self._request(
+            Operation.create_transfers,
+            types.transfers_to_np(transfers).tobytes(),
+        )
+        return decode_results(reply, Operation.create_transfers)
+
+    def lookup_accounts(self, ids: list[int]) -> list[types.Account]:
+        import numpy as np
+
+        reply = self._request(Operation.lookup_accounts, encode_ids(ids))
+        rows = np.frombuffer(reply, dtype=types.ACCOUNT_DTYPE)
+        return [types.Account.from_np(rows[i]) for i in range(len(rows))]
+
+    def lookup_transfers(self, ids: list[int]) -> list[types.Transfer]:
+        import numpy as np
+
+        reply = self._request(Operation.lookup_transfers, encode_ids(ids))
+        rows = np.frombuffer(reply, dtype=types.TRANSFER_DTYPE)
+        return [types.Transfer.from_np(rows[i]) for i in range(len(rows))]
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tb_client_deinit(self._handle)
+            self._handle = ctypes.POINTER(_TBClientHandle)()

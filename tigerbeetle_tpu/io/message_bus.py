@@ -1,0 +1,225 @@
+"""Production transport: the TCP message bus.
+
+The reference's MessageBus (reference: src/message_bus.zig:24-70): replicas
+listen on configured addresses and connect to each other; clients connect
+in; messages are 128-byte-Header-framed (size from the header, checksums
+validated by the receiver), with per-connection buffers and reconnect.
+
+This implements the same Network seam as the in-process fakes, so the
+Replica and Client run unchanged over real sockets. Non-blocking sockets
+pumped by the process event loop (`pump()` ~ the reference's io.run_for_ns
+tick, reference: src/tigerbeetle/main.zig start loop).
+
+Replica-to-replica links: the replica with the LOWER index connects, the
+higher accepts (a deterministic direction avoids duplicate links). Client
+links: clients connect in; the bus learns the client id from the first
+frame and routes replies back over the same connection.
+"""
+
+from __future__ import annotations
+
+import errno
+import selectors
+import socket
+
+from tigerbeetle_tpu.io.network import Address, Handler, Network
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Header
+
+MESSAGE_SIZE_MAX_DEFAULT = 1 << 20
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket, peer: Address | None = None,
+                 connected: bool = True):
+        self.sock = sock
+        self.peer = peer  # replica index / client id once known
+        self.connected = connected  # False while a non-blocking dial pends
+        self.rbuf = bytearray()
+        self.wbuf = bytearray()
+
+
+class TCPMessageBus(Network):
+    def __init__(
+        self,
+        addresses: list[tuple[str, int]],
+        own_address: Address,
+        listen: bool = False,
+        message_size_max: int = MESSAGE_SIZE_MAX_DEFAULT,
+    ):
+        """addresses: replica index -> (host, port). own_address: our
+        replica index, or our client id (clients don't listen)."""
+        self.addresses = addresses
+        self.own = own_address
+        self.message_size_max = message_size_max
+        self.sel = selectors.DefaultSelector()
+        self.handlers: dict[Address, Handler] = {}
+        self.conns: dict[Address, _Conn] = {}  # peer -> connection
+        self.listener: socket.socket | None = None
+        if listen:
+            host, port = addresses[own_address]
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, port))
+            s.listen(64)
+            s.setblocking(False)
+            self.listener = s
+            self.sel.register(s, selectors.EVENT_READ, ("accept", None))
+
+    # -- Network seam --
+
+    def attach(self, addr: Address, handler: Handler) -> None:
+        self.handlers[addr] = handler
+
+    def send(self, src: Address, dst: Address, data: bytes) -> None:
+        conn = self.conns.get(dst)
+        if conn is None:
+            if dst < len(self.addresses):
+                conn = self._connect(dst)
+            if conn is None:
+                return  # unreachable peer: VSR retransmits cover the loss
+        conn.wbuf += data
+        self._flush(conn)
+
+    # -- connections --
+
+    def _connect(self, replica: int) -> _Conn | None:
+        # NON-BLOCKING dial: a blocked peer must never stall the event loop
+        # (consensus for the live quorum would freeze for the TCP timeout).
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setblocking(False)
+        try:
+            rc = s.connect_ex(self.addresses[replica])
+        except OSError:
+            s.close()
+            return None
+        if rc not in (0, errno.EINPROGRESS, errno.EWOULDBLOCK):
+            s.close()
+            return None
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = _Conn(s, peer=replica, connected=(rc == 0))
+        self.conns[replica] = conn
+        self.sel.register(
+            s, selectors.EVENT_READ | selectors.EVENT_WRITE, ("conn", conn)
+        )
+        # identify ourselves so the acceptor can route replies (clients in
+        # the u128 `client` field; replicas in the u8 `replica` field)
+        hello = Header()
+        if self.own < len(self.addresses):
+            hello.replica = self.own
+        else:
+            hello.client = self.own
+        hello.set_checksum_body(b"")
+        hello.set_checksum()
+        conn.wbuf += hello.to_bytes()
+        self._flush(conn)
+        return conn
+
+    def _accept(self) -> None:
+        assert self.listener is not None
+        try:
+            s, _addr = self.listener.accept()
+        except OSError:
+            return
+        s.setblocking(False)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = _Conn(s)
+        self.sel.register(s, selectors.EVENT_READ, ("conn", conn))
+
+    def _close(self, conn: _Conn) -> None:
+        try:
+            self.sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        conn.sock.close()
+        if conn.peer is not None and self.conns.get(conn.peer) is conn:
+            del self.conns[conn.peer]
+
+    def _flush(self, conn: _Conn) -> None:
+        if not conn.connected:
+            return  # dial still in progress; flushed on writability
+        while conn.wbuf:
+            try:
+                n = conn.sock.send(conn.wbuf)
+            except OSError as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    return
+                self._close(conn)
+                return
+            if n <= 0:
+                return
+            del conn.wbuf[:n]
+
+    # -- pumping --
+
+    def pump(self, timeout: float = 0.01) -> int:
+        """One event-loop turn: accept/read/dispatch. Returns frames
+        dispatched."""
+        dispatched = 0
+        for key, mask in self.sel.select(timeout):
+            kind, conn = key.data
+            if kind == "accept":
+                self._accept()
+                continue
+            if mask & selectors.EVENT_WRITE and not conn.connected:
+                # pending dial resolved: success or failure
+                err = conn.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+                if err != 0:
+                    self._close(conn)
+                    continue
+                conn.connected = True
+                self.sel.modify(
+                    conn.sock, selectors.EVENT_READ, ("conn", conn)
+                )
+                self._flush(conn)
+            if not (mask & selectors.EVENT_READ):
+                continue
+            try:
+                chunk = conn.sock.recv(1 << 16)
+            except OSError as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    continue
+                self._close(conn)
+                continue
+            if not chunk:
+                self._close(conn)
+                continue
+            conn.rbuf += chunk
+            dispatched += self._drain(conn)
+        # opportunistic write flush
+        for conn in list(self.conns.values()):
+            if conn.wbuf:
+                self._flush(conn)
+        return dispatched
+
+    def _drain(self, conn: _Conn) -> int:
+        n = 0
+        while len(conn.rbuf) >= HEADER_SIZE:
+            header = Header.from_bytes(bytes(conn.rbuf[:HEADER_SIZE]))
+            size = header.size
+            if size < HEADER_SIZE or size > self.message_size_max:
+                self._close(conn)  # corrupt framing: drop the connection
+                return n
+            if len(conn.rbuf) < size:
+                break
+            frame = bytes(conn.rbuf[:size])
+            del conn.rbuf[:size]
+            if conn.peer is None:
+                # first frame identifies the peer (hello or any message:
+                # the client field for clients, replica for replicas)
+                if not header.valid_checksum():
+                    self._close(conn)
+                    return n
+                peer = header.client if header.client else header.replica
+                conn.peer = peer
+                # Simultaneous dials create two links; keep the FIRST as
+                # canonical for sends (an overwrite would orphan its
+                # buffered partial frames) — this one stays readable.
+                if peer not in self.conns:
+                    self.conns[peer] = conn
+                if size == HEADER_SIZE and header.command == 0:
+                    continue  # pure hello: consume
+            handler = self.handlers.get(self.own)
+            if handler is not None:
+                handler(conn.peer, frame)
+                n += 1
+        return n

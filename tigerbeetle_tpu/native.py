@@ -22,7 +22,10 @@ _lib: ctypes.CDLL | None = None
 
 
 def _build() -> None:
-    srcs = [os.path.join(_NATIVE_DIR, s) for s in ("aegis.cc", "storage.cc")]
+    srcs = [
+        os.path.join(_NATIVE_DIR, s)
+        for s in ("aegis.cc", "storage.cc", "tb_client.cc")
+    ]
     if os.path.exists(_LIB_PATH) and all(
         os.path.getmtime(_LIB_PATH) >= os.path.getmtime(s) for s in srcs
     ):

@@ -1,0 +1,33 @@
+"""StatsD metrics emitter (reference: src/statsd.zig:12 — UDP, fire and
+forget, used by the benchmark's --statsd flag)."""
+
+from __future__ import annotations
+
+import socket
+
+
+class StatsD:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "tigerbeetle_tpu"):
+        self.addr = (host, port)
+        self.prefix = prefix
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.setblocking(False)
+
+    def _send(self, payload: str) -> None:
+        try:
+            self.sock.sendto(payload.encode(), self.addr)
+        except OSError:
+            pass  # metrics are best-effort
+
+    def count(self, name: str, value: int = 1) -> None:
+        self._send(f"{self.prefix}.{name}:{value}|c")
+
+    def gauge(self, name: str, value: float) -> None:
+        self._send(f"{self.prefix}.{name}:{value}|g")
+
+    def timing(self, name: str, ms: float) -> None:
+        self._send(f"{self.prefix}.{name}:{ms}|ms")
+
+    def close(self) -> None:
+        self.sock.close()

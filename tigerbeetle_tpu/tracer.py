@@ -1,0 +1,87 @@
+"""Span tracer (reference: src/tracer.zig:48-77 — commit/prefetch/compact/
+io spans, backends none|Tracy).
+
+Backends here: `none` (no-op, zero overhead) and `json` (in-memory ring of
+spans dumped in Chrome trace-event format — load in about://tracing or
+Perfetto). Spans nest; the commit path and the bench driver emit them.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+class Tracer:
+    """No-op base (the `none` backend)."""
+
+    def start(self, name: str, **args) -> int:
+        return 0
+
+    def stop(self, token: int) -> None:
+        pass
+
+    def span(self, name: str, **args):
+        return _NullSpan()
+
+    def dump(self, path: str) -> None:
+        pass
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class JsonTracer(Tracer):
+    def __init__(self, capacity: int = 65536):
+        self.events: list[dict] = []
+        self.capacity = capacity
+        self._next = 1
+        self._open: dict[int, tuple[str, int, dict]] = {}
+
+    def start(self, name: str, **args) -> int:
+        token = self._next
+        self._next += 1
+        self._open[token] = (name, time.perf_counter_ns(), args)
+        return token
+
+    def stop(self, token: int) -> None:
+        name, t0, args = self._open.pop(token)
+        if len(self.events) < self.capacity:
+            self.events.append({
+                "name": name,
+                "ph": "X",  # complete event
+                "ts": t0 / 1000,  # Chrome traces are in microseconds
+                "dur": (time.perf_counter_ns() - t0) / 1000,
+                "pid": 0,
+                "tid": 0,
+                "args": args,
+            })
+
+    def span(self, name: str, **args):
+        return _Span(self, name, args)
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events}, f)
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "args", "token")
+
+    def __init__(self, tracer: JsonTracer, name: str, args: dict):
+        self.tracer = tracer
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self.token = self.tracer.start(self.name, **self.args)
+        return self
+
+    def __exit__(self, *a):
+        self.tracer.stop(self.token)
+        return False

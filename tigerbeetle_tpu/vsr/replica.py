@@ -112,6 +112,9 @@ class Replica:
         self._repair_wanted: set[int] = set()
         # test/simulator observation hook: called on every committed prepare
         self.commit_hook = None
+        # optional append-only disaster-recovery log (reference: src/aof.zig,
+        # hooked before the reply at src/vsr/replica.zig:3643-3648)
+        self.aof = None
 
         # tick + view-change state
         self.ticks = 0
@@ -767,6 +770,8 @@ class Replica:
         primary actually sends it. Returns the reply wire bytes."""
         if self.commit_hook is not None:
             self.commit_hook(header, body)
+        if self.aof is not None:
+            self.aof.append(header, body)  # durable before the reply
         operation = Operation(header.operation)
         if operation == Operation.register:
             self.client_table[header.client] = {
