@@ -1,0 +1,139 @@
+"""Foundation data structures + EWAH + free set + config fingerprint
+(reference: src/ring_buffer.zig, src/fifo.zig, src/iops.zig, src/ewah.zig,
+src/vsr/superblock_free_set.zig, src/config.zig fingerprint)."""
+
+import random
+
+import pytest
+
+from tigerbeetle_tpu.constants import ConfigCluster, TEST_CLUSTER
+from tigerbeetle_tpu.stdx import FIFO, IOPS, RingBuffer, ewah_decode, ewah_encode
+from tigerbeetle_tpu.vsr.free_set import FreeSet
+
+
+def test_ring_buffer():
+    rb = RingBuffer(3)
+    rb.push(1)
+    rb.push(2)
+    assert list(rb) == [1, 2] and len(rb) == 2
+    assert rb.pop() == 1
+    rb.push(3)
+    rb.push(4)
+    assert rb.full
+    with pytest.raises(AssertionError):
+        rb.push(5)
+    assert [rb.pop() for _ in range(3)] == [2, 3, 4]
+    with pytest.raises(AssertionError):
+        rb.pop()
+
+
+def test_fifo_intrusive():
+    class Item:
+        next = None
+
+        def __init__(self, v):
+            self.v = v
+
+    f = FIFO()
+    items = [Item(i) for i in range(5)]
+    for it in items:
+        f.push(it)
+    assert len(f) == 5
+    assert [f.pop().v for _ in range(5)] == [0, 1, 2, 3, 4]
+    assert f.pop() is None
+
+
+def test_iops_pool():
+    pool = IOPS(4)
+    slots = [pool.acquire() for _ in range(4)]
+    assert sorted(slots) == [0, 1, 2, 3]
+    assert pool.acquire() is None  # exhausted: backpressure, not allocation
+    assert pool.executing == 4
+    pool.release(2)
+    assert pool.acquire() == 2
+    with pytest.raises(AssertionError):
+        pool.release(3) or pool.release(3)  # double release
+
+
+def test_ewah_roundtrip():
+    rng = random.Random(7)
+    cases = [
+        [0] * 100,
+        [(1 << 64) - 1] * 100,
+        [rng.getrandbits(64) for _ in range(50)],
+        [0] * 10 + [123, 456] + [(1 << 64) - 1] * 20 + [789] + [0] * 5,
+        [],
+    ]
+    for words in cases:
+        enc = ewah_encode(words)
+        assert ewah_decode(enc, len(words)) == words
+    # compression: a sparse bitset shrinks dramatically
+    sparse = [0] * 1000
+    sparse[500] = 1 << 17
+    assert len(ewah_encode(sparse)) < 100  # vs 8000 raw bytes
+
+
+def test_free_set_disjoint_reservations():
+    fs = FreeSet(256)
+    r1 = fs.reserve(10)
+    r2 = fs.reserve(10)  # must NOT overlap r1's window
+    assert r1.block_base + r1.block_count <= r2.block_base
+    a1 = [fs.acquire(r1) for _ in range(10)]
+    a2 = [fs.acquire(r2) for _ in range(10)]
+    assert set(a1).isdisjoint(a2)
+    fs.forfeit(r1)
+    fs.forfeit(r2)
+    # all forfeited: the scan window resets
+    assert fs.reserve(5).block_base >= 0
+
+
+def test_ewah_truncation_detected():
+    words = [7, 8, 9]
+    enc = ewah_encode(words)
+    with pytest.raises(ValueError):
+        ewah_decode(enc[:-3], 3)
+    with pytest.raises(ValueError):
+        ewah_decode(enc, 5)  # fewer words than promised
+
+
+def test_free_set_reservations_and_trailer():
+    fs = FreeSet(256)
+    assert fs.count_free() == 256
+    r = fs.reserve(10)
+    addrs = [fs.acquire(r) for _ in range(10)]
+    assert addrs == list(range(1, 11))
+    assert fs.count_free() == 246
+    fs.forfeit(r)
+    with pytest.raises(AssertionError):
+        fs.acquire(r)  # stale reservation session
+    fs.release(5)
+    with pytest.raises(AssertionError):
+        fs.release(5)  # double free
+    # trailer roundtrip (EWAH over the words)
+    enc = fs.encode()
+    fs2 = FreeSet.decode(enc, 256)
+    assert fs2.words == fs.words
+    assert not fs2.is_free(1) and fs2.is_free(5)
+
+
+def test_config_fingerprint_guard():
+    from tigerbeetle_tpu.io.storage import MemoryStorage, ZoneLayout
+    from tigerbeetle_tpu.vsr.durable import (
+        check_config_fingerprint,
+        format_data_file,
+    )
+    from tigerbeetle_tpu.vsr.superblock import SuperBlock
+
+    a = TEST_CLUSTER
+    b = ConfigCluster(journal_slot_count=128, lsm_batch_multiple=4)
+    assert a.fingerprint() != b.fingerprint()
+    assert a.fingerprint() == ConfigCluster(
+        journal_slot_count=64, lsm_batch_multiple=4
+    ).fingerprint()
+
+    storage = MemoryStorage(ZoneLayout(a, grid_size=1 << 20))
+    format_data_file(storage, a)
+    state = SuperBlock(storage).open()
+    check_config_fingerprint(state, a)  # matching: fine
+    with pytest.raises(RuntimeError, match="different cluster config"):
+        check_config_fingerprint(state, b)

@@ -62,6 +62,18 @@ class ConfigCluster:
         # reference: src/vsr.zig:2003-2035 Checkpoint arithmetic.
         return self.journal_slot_count - self.lsm_batch_multiple
 
+    def fingerprint(self) -> int:
+        """Checksum of the consensus-affecting constants. Stored in the
+        superblock at format and verified on open, so replicas built with
+        mismatched cluster configs cannot silently join one cluster
+        (reference: src/config.zig:167-179 cluster-config checksum)."""
+        import json
+
+        from tigerbeetle_tpu import native
+
+        payload = json.dumps(dataclasses.asdict(self), sort_keys=True)
+        return native.checksum(payload.encode())
+
 
 @dataclasses.dataclass(frozen=True)
 class ConfigProcess:

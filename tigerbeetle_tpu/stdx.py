@@ -1,0 +1,177 @@
+"""Foundation data structures (reference: src/stdx.zig, src/ring_buffer.zig,
+src/fifo.zig, src/iops.zig, src/ewah.zig — the statically-sized pools and
+codecs everything above is built from)."""
+
+from __future__ import annotations
+
+
+class RingBuffer:
+    """Fixed-capacity FIFO ring (reference: src/ring_buffer.zig). Pushing
+    into a full ring is an error — static allocation discipline: capacity
+    is sized exactly, never grown."""
+
+    def __init__(self, capacity: int):
+        assert capacity > 0
+        self.buf: list = [None] * capacity
+        self.capacity = capacity
+        self.head = 0  # read position
+        self.count = 0
+
+    def __len__(self) -> int:
+        return self.count
+
+    @property
+    def full(self) -> bool:
+        return self.count == self.capacity
+
+    def push(self, item) -> None:
+        assert not self.full, "ring buffer full"
+        self.buf[(self.head + self.count) % self.capacity] = item
+        self.count += 1
+
+    def pop(self):
+        assert self.count > 0, "ring buffer empty"
+        item = self.buf[self.head]
+        self.buf[self.head] = None
+        self.head = (self.head + 1) % self.capacity
+        self.count -= 1
+        return item
+
+    def peek(self):
+        assert self.count > 0
+        return self.buf[self.head]
+
+    def __iter__(self):
+        for i in range(self.count):
+            yield self.buf[(self.head + i) % self.capacity]
+
+
+class FIFO:
+    """Intrusive singly-linked FIFO (reference: src/fifo.zig): items carry
+    their own `next` link, so push/pop never allocate."""
+
+    def __init__(self):
+        self.head = None
+        self.tail = None
+        self.count = 0
+
+    def push(self, item) -> None:
+        assert getattr(item, "next", None) is None, "item already queued"
+        item.next = None
+        if self.tail is None:
+            self.head = self.tail = item
+        else:
+            self.tail.next = item
+            self.tail = item
+        self.count += 1
+
+    def pop(self):
+        item = self.head
+        if item is None:
+            return None
+        self.head = item.next
+        if self.head is None:
+            self.tail = None
+        item.next = None
+        self.count -= 1
+        return item
+
+    def __len__(self) -> int:
+        return self.count
+
+
+class IOPS:
+    """Fixed pool of in-flight operation slots tracked by a free bitset
+    (reference: src/iops.zig:5): acquire returns a slot index or None when
+    the pool is exhausted — backpressure, never allocation."""
+
+    def __init__(self, size: int):
+        assert 0 < size <= 64
+        self.size = size
+        self.free = (1 << size) - 1  # bit set = slot free
+
+    def acquire(self) -> int | None:
+        if self.free == 0:
+            return None
+        slot = (self.free & -self.free).bit_length() - 1
+        self.free &= ~(1 << slot)
+        return slot
+
+    def release(self, slot: int) -> None:
+        assert 0 <= slot < self.size
+        assert not self.free & (1 << slot), "double release"
+        self.free |= 1 << slot
+
+    @property
+    def executing(self) -> int:
+        return self.size - bin(self.free).count("1")
+
+    @property
+    def available(self) -> int:
+        return bin(self.free).count("1")
+
+
+# ----------------------------------------------------------------------
+# EWAH codec (reference: src/ewah.zig — word-aligned hybrid RLE over u64
+# words; compresses the superblock's free-set bitset trailer)
+# ----------------------------------------------------------------------
+
+_WORD = 64
+_ALL_ONES = (1 << 64) - 1
+# marker layout (reference ewah.zig): bit 0 = uniform bit value,
+# bits 1..32 = uniform word run length, bits 33..63 = literal word count
+_RUN_MAX = (1 << 32) - 1
+_LIT_MAX = (1 << 31) - 1
+
+
+def ewah_encode(words: list[int]) -> bytes:
+    """u64 word array -> EWAH bytes: [marker][literal words...] repeated."""
+    out = bytearray()
+    i = 0
+    n = len(words)
+    while i < n:
+        # uniform run (all-zero or all-one words)
+        bit = 0
+        run = 0
+        if words[i] in (0, _ALL_ONES):
+            bit = 1 if words[i] == _ALL_ONES else 0
+            target = _ALL_ONES if bit else 0
+            while i < n and words[i] == target and run < _RUN_MAX:
+                run += 1
+                i += 1
+        # literals until the next uniform word
+        lit_start = i
+        while (
+            i < n
+            and words[i] not in (0, _ALL_ONES)
+            and (i - lit_start) < _LIT_MAX
+        ):
+            i += 1
+        lit = i - lit_start
+        marker = bit | (run << 1) | (lit << 33)
+        out += marker.to_bytes(8, "little")
+        for w in words[lit_start:i]:
+            out += w.to_bytes(8, "little")
+    return bytes(out)
+
+
+def ewah_decode(data: bytes, words_count: int) -> list[int]:
+    words: list[int] = []
+    off = 0
+    while off < len(data) and len(words) < words_count:
+        if off + 8 > len(data):
+            raise ValueError("ewah: truncated marker")
+        marker = int.from_bytes(data[off : off + 8], "little")
+        off += 8
+        bit = marker & 1
+        run = (marker >> 1) & _RUN_MAX
+        lit = marker >> 33
+        words.extend([_ALL_ONES if bit else 0] * run)
+        if off + 8 * lit > len(data):
+            raise ValueError("ewah: truncated literals")
+        for _ in range(lit):
+            words.append(int.from_bytes(data[off : off + 8], "little"))
+            off += 8
+    if len(words) != words_count:
+        raise ValueError(f"ewah: decoded {len(words)} of {words_count} words")
+    return words

@@ -56,7 +56,22 @@ def format_data_file(storage: Storage, cluster: ConfigCluster = DEFAULT_CLUSTER,
     """Create a fresh data file: superblock sequence 1, empty WAL
     (reference: src/vsr/replica_format.zig)."""
     sb = SuperBlock(storage)
-    sb.checkpoint(VSRState(cluster=cluster_id, replica=replica, sequence=1))
+    sb.checkpoint(VSRState(
+        cluster=cluster_id, replica=replica, sequence=1,
+        meta={"config_fingerprint": str(cluster.fingerprint())},
+    ))
+
+
+def check_config_fingerprint(state, cluster: ConfigCluster) -> None:
+    """Mixed-config guard (reference: src/config.zig:167-179): refuse to
+    open a data file formatted with different consensus-affecting
+    constants."""
+    want = state.meta.get("config_fingerprint")
+    if want is not None and int(want) != cluster.fingerprint():
+        raise RuntimeError(
+            "data file was formatted with a different cluster config "
+            "(consensus-affecting constants differ) — refusing to start"
+        )
 
 
 def snapshot_to_superblock(
@@ -82,6 +97,11 @@ def snapshot_to_superblock(
     area_size = storage.layout.sizes[Zone.grid] // 2
     base = area * area_size
 
+    carry = {  # format-time identity survives every checkpoint
+        k: state.meta[k]
+        for k in ("config_fingerprint",)
+        if k in state.meta
+    }
     blobs: list[BlobRef] = []
     off = base
     if hasattr(ledger, "state"):  # device ledger: HBM tables as blobs
@@ -100,6 +120,7 @@ def snapshot_to_superblock(
             "xfer_used": ledger._xfer_used,
             "amount_sum": str(h.amount_sum),  # may exceed u64: JSON as str
             "limit_account_ids": [str(x) for x in sorted(h.limit_account_ids)],
+            **carry,
             **(extra_meta or {}),
         }
         assert meta["fault"] == 0, "refusing to checkpoint a faulted ledger"
@@ -108,7 +129,7 @@ def snapshot_to_superblock(
         assert off + len(data) <= base + area_size, "grid area overflow"
         storage.write(Zone.grid, off, data)
         blobs.append(BlobRef("oracle", off, len(data), native.checksum(data)))
-        meta = {"fault": 0, **(extra_meta or {})}
+        meta = {"fault": 0, **carry, **(extra_meta or {})}
     storage.sync()  # blobs durable before the superblock points at them
 
     superblock.checkpoint(VSRState(
@@ -217,6 +238,7 @@ class DurableLedger:
     def open(self) -> None:
         """Superblock quorum -> snapshot restore -> WAL replay."""
         state = self.superblock.open()
+        check_config_fingerprint(state, self.cluster)
         self._restore_snapshot(state)
         self.checkpoint_op = state.commit_min
         self.op = state.commit_min

@@ -45,6 +45,7 @@ from tigerbeetle_tpu.state_machine import StateMachine
 from tigerbeetle_tpu.types import Operation
 from tigerbeetle_tpu.vsr.clock import Clock
 from tigerbeetle_tpu.vsr.durable import (
+    check_config_fingerprint,
     persist_view,
     restore_from_snapshot,
     snapshot_to_superblock,
@@ -154,6 +155,7 @@ class Replica:
         """Superblock -> snapshot -> WAL replay (same recovery as the
         single-replica DurableLedger, then join the cluster)."""
         state = self.superblock.open()
+        check_config_fingerprint(state, self.cluster)
         restore_from_snapshot(
             self.storage, self.ledger, self.sm, self.ledger.process, state
         )
