@@ -86,12 +86,14 @@ FAULT_PROBE = 1  # fast-tier lookup window exhausted (batch was a no-op)
 FAULT_CLAIM = 2  # fast-tier claim rounds exhausted (batch was a no-op)
 FAULT_OVERFLOW = 4  # device-side overflow backstop tripped (batch was a no-op)
 FAULT_SERIAL = 8  # serial-tier probe window exhausted — STATE IS CORRUPT
+FAULT_CAPACITY = 16  # device-side load-factor guard tripped (batch no-op)
 
 _FAULT_NAMES = (
     (FAULT_PROBE, "probe-window"),
     (FAULT_CLAIM, "claim-rounds"),
     (FAULT_OVERFLOW, "overflow-backstop"),
     (FAULT_SERIAL, "serial-probe"),
+    (FAULT_CAPACITY, "capacity-guard"),
 )
 
 
@@ -220,6 +222,11 @@ def init_state(process: ConfigProcess = DEFAULT_PROCESS) -> dict:
         "commit_ts": jnp.uint64(0),
         "acct_count": jnp.uint64(0),
         "xfer_count": jnp.uint64(0),
+        # ever-applied insert counters (rolled-back inserts INCLUDED: their
+        # tombstones still lengthen probe chains) — the DEVICE-side
+        # load-factor guard, independent of the host's estimate
+        "acct_used_slots": jnp.uint64(0),
+        "xfer_used_slots": jnp.uint64(0),
         "fault": jnp.uint32(0),
     }
 
@@ -419,11 +426,16 @@ class LedgerKernels:
         )
         acc = acc.at[slots_t].set(jnp.zeros_like(upd))  # restore all-zero
 
+        # Device-side load-factor guard (independent of the host estimate:
+        # a desynced host must not re-expose unbounded probe densities).
+        ok_n = jnp.sum(ok).astype(U64)
+        cap_bad = state["xfer_used_slots"] + ok_n > np.uint64(self.t_dump // 2)
         fault = (
             state["fault"]
             | jnp.where(probe_bad, jnp.uint32(FAULT_PROBE), jnp.uint32(0))
             | jnp.where(claim_bad, jnp.uint32(FAULT_CLAIM), jnp.uint32(0))
             | jnp.where(over_bad, jnp.uint32(FAULT_OVERFLOW), jnp.uint32(0))
+            | jnp.where(cap_bad, jnp.uint32(FAULT_CAPACITY), jnp.uint32(0))
         )
         proceed = fault == 0  # sticky: also no-ops every batch after a fault
 
@@ -444,7 +456,9 @@ class LedgerKernels:
             "bal_acc": acc,
             "commit_ts": jnp.where(applied, last_ts, state["commit_ts"]),
             "xfer_count": state["xfer_count"]
-            + jnp.where(proceed, jnp.sum(ok).astype(U64), jnp.uint64(0)),
+            + jnp.where(proceed, ok_n, jnp.uint64(0)),
+            "xfer_used_slots": state["xfer_used_slots"]
+            + jnp.where(proceed, ok_n, jnp.uint64(0)),
             "fault": fault,
         }, r
 
@@ -456,8 +470,16 @@ class LedgerKernels:
         lanes = jnp.arange(B, dtype=I32)
         a_dump, t_dump = self.a_dump, self.t_dump
         tomb_row = _TOMB_ROW  # numpy: embeds as a literal
-        # Sticky-fault entry gate: a faulted ledger commits nothing.
-        n = jnp.where(state["fault"] == 0, n, jnp.int32(0))
+        # Entry gates: sticky fault + the device-side load-factor guard
+        # (conservative: charges all n events; the scan applies as it goes
+        # and cannot un-apply, so it must not START near the limit).
+        cap_bad = state["xfer_used_slots"] + n.astype(U64) > np.uint64(
+            self.t_dump // 2
+        )
+        fault0 = state["fault"] | jnp.where(
+            cap_bad, jnp.uint32(FAULT_CAPACITY), jnp.uint32(0)
+        )
+        n = jnp.where(fault0 == 0, n, jnp.int32(0))
 
         undo0 = {
             "kind": jnp.zeros(B, dtype=U32),
@@ -712,9 +734,13 @@ class LedgerKernels:
                 chain_start, chain_broken, commit_ts, probe_bad,
             ), None
 
-        (acct_rows, xfer_rows, fulfill, results, _, _, _, commit_ts,
+        (acct_rows, xfer_rows, fulfill, results, undo, _, _, commit_ts,
          probe_bad), _ = jax.lax.scan(step, carry0, (lanes, rows_b))
         ok_n = jnp.sum((results == 0) & (lanes < n)).astype(U64)
+        # Ever-applied inserts (rolled-back ones leave tombstones): the
+        # undo log's kind stays set through rollback — exactly the count
+        # the device-side load guard needs.
+        applied_n = jnp.sum((undo["kind"] != 0).astype(U64))
         # commit_ts advanced on at-the-time-ok events and, like the oracle's
         # scopes, is NOT restored by chain rollback — return the carry as-is.
         # An unresolved probe mid-scan cannot be rolled back: FAULT_SERIAL
@@ -726,7 +752,8 @@ class LedgerKernels:
             "fulfill": fulfill,
             "commit_ts": commit_ts,
             "xfer_count": state["xfer_count"] + ok_n,
-            "fault": state["fault"]
+            "xfer_used_slots": state["xfer_used_slots"] + applied_n,
+            "fault": fault0
             | jnp.where(probe_bad, jnp.uint32(FAULT_SERIAL), jnp.uint32(0)),
         }, results
 
@@ -761,10 +788,13 @@ class LedgerKernels:
         )
         claim_bad = jnp.any(~ins_res)
 
+        ok_n = jnp.sum(ok).astype(U64)
+        cap_bad = state["acct_used_slots"] + ok_n > np.uint64(self.a_dump // 2)
         fault = (
             state["fault"]
             | jnp.where(probe_bad, jnp.uint32(FAULT_PROBE), jnp.uint32(0))
             | jnp.where(claim_bad, jnp.uint32(FAULT_CLAIM), jnp.uint32(0))
+            | jnp.where(cap_bad, jnp.uint32(FAULT_CAPACITY), jnp.uint32(0))
         )
         proceed = fault == 0
 
@@ -779,7 +809,9 @@ class LedgerKernels:
             "acct_claim": claim,
             "commit_ts": jnp.where(applied, last_ts, state["commit_ts"]),
             "acct_count": state["acct_count"]
-            + jnp.where(proceed, jnp.sum(ok).astype(U64), jnp.uint64(0)),
+            + jnp.where(proceed, ok_n, jnp.uint64(0)),
+            "acct_used_slots": state["acct_used_slots"]
+            + jnp.where(proceed, ok_n, jnp.uint64(0)),
             "fault": fault,
         }, r
 
@@ -789,7 +821,13 @@ class LedgerKernels:
         lanes = jnp.arange(B, dtype=I32)
         a_dump = self.a_dump
         tomb_row = _TOMB_ROW  # numpy: embeds as a literal
-        n = jnp.where(state["fault"] == 0, n, jnp.int32(0))
+        cap_bad = state["acct_used_slots"] + n.astype(U64) > np.uint64(
+            self.a_dump // 2
+        )
+        fault0 = state["fault"] | jnp.where(
+            cap_bad, jnp.uint32(FAULT_CAPACITY), jnp.uint32(0)
+        )
+        n = jnp.where(fault0 == 0, n, jnp.int32(0))
 
         undo0 = {
             "slot": jnp.zeros(B, dtype=I32),
@@ -864,16 +902,18 @@ class LedgerKernels:
             return (acct_rows, results, undo, chain_start, chain_broken,
                     commit_ts, probe_bad), None
 
-        (acct_rows, results, _, _, _, commit_ts, probe_bad), _ = jax.lax.scan(
+        (acct_rows, results, undo, _, _, commit_ts, probe_bad), _ = jax.lax.scan(
             step, carry0, (lanes, rows_b)
         )
         ok_n = jnp.sum((results == 0) & (lanes < n)).astype(U64)
+        applied_n = jnp.sum((undo["kind"] != 0).astype(U64))
         return {
             **state,
             "acct_rows": acct_rows,
             "commit_ts": commit_ts,
             "acct_count": state["acct_count"] + ok_n,
-            "fault": state["fault"]
+            "acct_used_slots": state["acct_used_slots"] + applied_n,
+            "fault": fault0
             | jnp.where(probe_bad, jnp.uint32(FAULT_SERIAL), jnp.uint32(0)),
         }, results
 

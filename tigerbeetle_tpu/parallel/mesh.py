@@ -58,6 +58,7 @@ except ImportError:  # pragma: no cover — older jax (kwarg: check_rep)
 from tigerbeetle_tpu.constants import ConfigProcess
 from tigerbeetle_tpu.models import validate
 from tigerbeetle_tpu.models.ledger import (
+    FAULT_CAPACITY,
     FAULT_CLAIM,
     FAULT_OVERFLOW,
     FAULT_PROBE,
@@ -144,6 +145,9 @@ def init_sharded_state(mesh: Mesh, process: ConfigProcess) -> dict:
         "acct_claim": put(jnp.full((n, a_rows), ht.CLAIM_FREE, dtype=U32), sh),
         "xfer_claim": put(jnp.full((n, t_rows), ht.CLAIM_FREE, dtype=U32), sh),
         "bal_acc": put(jnp.zeros((n, a_rows, ROW_WORDS), dtype=U32), sh),
+        # per-shard ever-applied insert counters (device load guard)
+        "acct_used_slots": put(jnp.zeros((n,), dtype=jnp.uint64), sh),
+        "xfer_used_slots": put(jnp.zeros((n,), dtype=jnp.uint64), sh),
         "commit_ts": put(jnp.uint64(0), sc),
         "acct_count": put(jnp.uint64(0), sc),
         "xfer_count": put(jnp.uint64(0), sc),
@@ -169,7 +173,8 @@ class ShardedLedgerKernels:
         self.t_dump = 1 << self.t_log2
 
         sharded_keys = (
-            "acct_rows", "xfer_rows", "fulfill", "acct_claim", "xfer_claim", "bal_acc"
+            "acct_rows", "xfer_rows", "fulfill", "acct_claim", "xfer_claim",
+            "bal_acc", "acct_used_slots", "xfer_used_slots",
         )
         state_spec = {k: P("shard") for k in sharded_keys}
         state_spec.update(
@@ -286,14 +291,21 @@ class ShardedLedgerKernels:
         )
         acc = acc.at[slots_t].set(jnp.zeros_like(upd))
 
-        claim_bad, over_bad = jax.lax.psum(
-            (claim_bad_l.astype(U32), over_bad_l.astype(U32)), "shard"
+        # per-shard device load guard over owned inserts
+        ins_n = jnp.sum(ins).astype(jnp.uint64)
+        cap_bad_l = state["xfer_used_slots"][0] + ins_n > np.uint64(
+            self.t_dump // 2
+        )
+        claim_bad, over_bad, cap_bad = jax.lax.psum(
+            (claim_bad_l.astype(U32), over_bad_l.astype(U32),
+             cap_bad_l.astype(U32)), "shard"
         )
         fault = (
             state["fault"]
             | jnp.where(probe_bad, jnp.uint32(FAULT_PROBE), jnp.uint32(0))
             | jnp.where(claim_bad > 0, jnp.uint32(FAULT_CLAIM), jnp.uint32(0))
             | jnp.where(over_bad > 0, jnp.uint32(FAULT_OVERFLOW), jnp.uint32(0))
+            | jnp.where(cap_bad > 0, jnp.uint32(FAULT_CAPACITY), jnp.uint32(0))
         )
         proceed = fault == 0
 
@@ -313,6 +325,9 @@ class ShardedLedgerKernels:
             "acct_claim": state["acct_claim"],
             "xfer_claim": claim[None],
             "bal_acc": acc[None],
+            "acct_used_slots": state["acct_used_slots"],
+            "xfer_used_slots": state["xfer_used_slots"]
+            + jnp.where(proceed, ins_n, jnp.uint64(0))[None],
             "commit_ts": jnp.where(applied, last_ts, state["commit_ts"]),
             "acct_count": state["acct_count"],
             "xfer_count": state["xfer_count"]
@@ -347,12 +362,19 @@ class ShardedLedgerKernels:
         ins_slots, claim, ins_res = ht.claim_slots(
             rows_b[:, :4], ins, acct_rows, state["acct_claim"][0], self.a_log2
         )
-        claim_bad = jax.lax.psum(jnp.any(~ins_res).astype(U32), "shard") > 0
+        ins_n = jnp.sum(ins).astype(jnp.uint64)
+        cap_bad_l = state["acct_used_slots"][0] + ins_n > np.uint64(
+            self.a_dump // 2
+        )
+        claim_bad_c, cap_bad_c = jax.lax.psum(
+            (jnp.any(~ins_res).astype(U32), cap_bad_l.astype(U32)), "shard"
+        )
 
         fault = (
             state["fault"]
             | jnp.where(probe_bad, jnp.uint32(FAULT_PROBE), jnp.uint32(0))
-            | jnp.where(claim_bad, jnp.uint32(FAULT_CLAIM), jnp.uint32(0))
+            | jnp.where(claim_bad_c > 0, jnp.uint32(FAULT_CLAIM), jnp.uint32(0))
+            | jnp.where(cap_bad_c > 0, jnp.uint32(FAULT_CAPACITY), jnp.uint32(0))
         )
         proceed = fault == 0
 
@@ -369,6 +391,9 @@ class ShardedLedgerKernels:
             "acct_claim": claim[None],
             "xfer_claim": state["xfer_claim"],
             "bal_acc": state["bal_acc"],
+            "acct_used_slots": state["acct_used_slots"]
+            + jnp.where(proceed, ins_n, jnp.uint64(0))[None],
+            "xfer_used_slots": state["xfer_used_slots"],
             "commit_ts": jnp.where(applied, last_ts, state["commit_ts"]),
             "acct_count": state["acct_count"]
             + jnp.where(proceed, jnp.sum(ok).astype(U64), jnp.uint64(0)),
@@ -409,7 +434,16 @@ class ShardedLedgerKernels:
         lanes = jnp.arange(B, dtype=I32)
         a_dump, t_dump = self.a_dump, self.t_dump
         tomb_row = _TOMB_ROW  # numpy: embeds as a literal
-        n = jnp.where(state["fault"] == 0, n, jnp.int32(0))
+        # entry gates: sticky fault + per-shard device load guard
+        # (conservative: all n events charged against every shard)
+        cap_bad_l = state["xfer_used_slots"][0] + n.astype(U64) > np.uint64(
+            self.t_dump // 2
+        )
+        cap_bad = jax.lax.psum(cap_bad_l.astype(U32), "shard") > 0
+        fault0 = state["fault"] | jnp.where(
+            cap_bad, jnp.uint32(FAULT_CAPACITY), jnp.uint32(0)
+        )
+        n = jnp.where(fault0 == 0, n, jnp.int32(0))
 
         undo0 = {
             "kind": jnp.zeros(B, dtype=U32),
@@ -685,9 +719,10 @@ class ShardedLedgerKernels:
                 chain_start, chain_broken, commit_ts, probe_bad,
             ), None
 
-        (acct_rows, xfer_rows, fulfill, results, _, _, _, commit_ts,
+        (acct_rows, xfer_rows, fulfill, results, undo, _, _, commit_ts,
          probe_bad), _ = jax.lax.scan(step, carry0, (lanes, rows_b))
         ok_n = jnp.sum((results == 0) & (lanes < n)).astype(U64)
+        applied_l = jnp.sum(((undo["kind"] != 0) & undo["t_mine"]).astype(U64))
         new_state = {
             "acct_rows": acct_rows[None],
             "xfer_rows": xfer_rows[None],
@@ -695,10 +730,12 @@ class ShardedLedgerKernels:
             "acct_claim": state["acct_claim"],
             "xfer_claim": state["xfer_claim"],
             "bal_acc": state["bal_acc"],
+            "acct_used_slots": state["acct_used_slots"],
+            "xfer_used_slots": state["xfer_used_slots"] + applied_l[None],
             "commit_ts": commit_ts,
             "acct_count": state["acct_count"],
             "xfer_count": state["xfer_count"] + ok_n,
-            "fault": state["fault"]
+            "fault": fault0
             | jnp.where(probe_bad, jnp.uint32(FAULT_SERIAL), jnp.uint32(0)),
         }
         return new_state, results
@@ -710,7 +747,14 @@ class ShardedLedgerKernels:
         lanes = jnp.arange(B, dtype=I32)
         a_dump = self.a_dump
         tomb_row = _TOMB_ROW  # numpy: embeds as a literal
-        n = jnp.where(state["fault"] == 0, n, jnp.int32(0))
+        cap_bad_l = state["acct_used_slots"][0] + n.astype(U64) > np.uint64(
+            self.a_dump // 2
+        )
+        cap_bad = jax.lax.psum(cap_bad_l.astype(U32), "shard") > 0
+        fault0 = state["fault"] | jnp.where(
+            cap_bad, jnp.uint32(FAULT_CAPACITY), jnp.uint32(0)
+        )
+        n = jnp.where(fault0 == 0, n, jnp.int32(0))
 
         undo0 = {
             "slot": jnp.zeros(B, dtype=I32),
@@ -791,10 +835,11 @@ class ShardedLedgerKernels:
             return (acct_rows, results, undo, chain_start, chain_broken,
                     commit_ts, probe_bad), None
 
-        (acct_rows, results, _, _, _, commit_ts, probe_bad), _ = jax.lax.scan(
+        (acct_rows, results, undo, _, _, commit_ts, probe_bad), _ = jax.lax.scan(
             step, carry0, (lanes, rows_b)
         )
         ok_n = jnp.sum((results == 0) & (lanes < n)).astype(U64)
+        applied_l = jnp.sum(((undo["kind"] != 0) & undo["mine"]).astype(U64))
         new_state = {
             "acct_rows": acct_rows[None],
             "xfer_rows": state["xfer_rows"],
@@ -802,10 +847,12 @@ class ShardedLedgerKernels:
             "acct_claim": state["acct_claim"],
             "xfer_claim": state["xfer_claim"],
             "bal_acc": state["bal_acc"],
+            "acct_used_slots": state["acct_used_slots"] + applied_l[None],
+            "xfer_used_slots": state["xfer_used_slots"],
             "commit_ts": commit_ts,
             "acct_count": state["acct_count"] + ok_n,
             "xfer_count": state["xfer_count"],
-            "fault": state["fault"]
+            "fault": fault0
             | jnp.where(probe_bad, jnp.uint32(FAULT_SERIAL), jnp.uint32(0)),
         }
         return new_state, results

@@ -116,7 +116,6 @@ class IOPS:
 # words; compresses the superblock's free-set bitset trailer)
 # ----------------------------------------------------------------------
 
-_WORD = 64
 _ALL_ONES = (1 << 64) - 1
 # marker layout (reference ewah.zig): bit 0 = uniform bit value,
 # bits 1..32 = uniform word run length, bits 33..63 = literal word count
@@ -166,6 +165,10 @@ def ewah_decode(data: bytes, words_count: int) -> list[int]:
         bit = marker & 1
         run = (marker >> 1) & _RUN_MAX
         lit = marker >> 33
+        if len(words) + run + lit > words_count:
+            # reject before materializing: a corrupt marker's 2^32-word run
+            # must raise, not OOM
+            raise ValueError("ewah: marker exceeds expected word count")
         words.extend([_ALL_ONES if bit else 0] * run)
         if off + 8 * lit > len(data):
             raise ValueError("ewah: truncated literals")

@@ -48,7 +48,10 @@ from tigerbeetle_tpu.vsr.journal import Journal
 from tigerbeetle_tpu.vsr.superblock import BlobRef, SuperBlock, VSRState
 
 SNAPSHOT_LEAVES = ("acct_rows", "xfer_rows", "fulfill")
-COUNTER_LEAVES = ("commit_ts", "acct_count", "xfer_count")
+COUNTER_LEAVES = (
+    "commit_ts", "acct_count", "xfer_count",
+    "acct_used_slots", "xfer_used_slots",
+)
 
 
 def format_data_file(storage: Storage, cluster: ConfigCluster = DEFAULT_CLUSTER,
@@ -194,7 +197,8 @@ def restore_from_snapshot(
             dev[ref.name] = jnp.asarray(host)
         counters = state.meta["counters"]
         for k in COUNTER_LEAVES:
-            dev[k] = jnp.uint64(int(counters[k]))
+            # .get: checkpoints from before a counter existed restore as 0
+            dev[k] = jnp.uint64(int(counters.get(k, 0)))
         ledger._acct_used = int(state.meta["acct_used"])
         ledger._xfer_used = int(state.meta["xfer_used"])
         h = ledger.hazards
