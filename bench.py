@@ -130,6 +130,147 @@ def make_device_stepper(kernels, n_pad: int, k_fuse: int):
     return jax.jit(step, donate_argnums=(0,))
 
 
+def bench_tracked_configs(stage) -> dict:
+    """BASELINE.json's five tracked configs beyond the flagship: the read
+    path, pure two-phase, linked chains, balancing (exact serial tier), and
+    a realistic mixed batch exercising the conflict-partitioned middle
+    tier. Synced per batch (these are serial/residue-dominated, so dispatch
+    overlap is irrelevant); a warmup batch per config absorbs compiles."""
+    import jax
+    import jax.numpy as jnp
+
+    from tigerbeetle_tpu.constants import BATCH_PAD, ConfigProcess
+    from tigerbeetle_tpu.models.ledger import DeviceLedger, ids_to_batch
+    from tigerbeetle_tpu.types import TRANSFER_DTYPE, Operation
+
+    out = {}
+    rng = np.random.default_rng(77)
+
+    def fresh(n_accounts=N_ACCOUNTS):
+        process = ConfigProcess(account_slots_log2=16, transfer_slots_log2=22)
+        ledger = DeviceLedger(process=process, mode="auto")
+        ledger.pad_to = BATCH_PAD
+        ts = 1 << 40
+        next_id = 1
+        while next_id <= n_accounts:
+            k = min(BATCH, n_accounts - next_id + 1)
+            ts += k
+            ledger.execute_async(
+                Operation.create_accounts, ts, build_accounts(next_id, k)
+            )
+            next_id += k
+        return ledger, ts
+
+    def run_batches(name, ledger, ts, batches, events_per_batch=BATCH,
+                    warmup=1):
+        """`warmup` batches absorb jit compiles and must exercise every tier
+        the timed batches hit (two-phase passes 2: pending=fast, post=serial)."""
+        pends = []
+        for b in batches[:warmup]:
+            ts += events_per_batch
+            pends.append(ledger.execute_async(Operation.create_transfers, ts, b))
+        jax.block_until_ready(pends[-1].results)
+        t0 = time.perf_counter()
+        n = 0
+        for b in batches[warmup:]:
+            ts += events_per_batch
+            p = ledger.execute_async(Operation.create_transfers, ts, b)
+            jax.block_until_ready(p.results)
+            n += events_per_batch
+        out[name] = round(n / (time.perf_counter() - t0), 1)
+        return ts
+
+    # 1. read path: lookup_accounts over full id batches
+    with stage("cfg_lookup"):
+        ledger, ts = fresh()
+        ids = ids_to_batch(
+            [int(x) for x in rng.integers(1, N_ACCOUNTS + 1, size=BATCH)],
+            BATCH_PAD,
+        )
+        k = ledger.kernels.lookup_accounts
+        jax.block_until_ready(k(ledger.state, ids)[0])  # compile
+        t0 = time.perf_counter()
+        for _ in range(20):
+            found, rows, res = k(ledger.state, ids)
+        jax.block_until_ready(found)
+        out["lookup_accounts_per_s"] = round(20 * BATCH / (time.perf_counter() - t0), 1)
+
+    # 2. two-phase: full pending batches then full post batches (all-serial)
+    with stage("cfg_two_phase"):
+        ledger, ts = fresh()
+        batches = []
+        for g in range(4):
+            base = 1 + g * 2 * BATCH
+            pend = build_transfers(rng, base, BATCH)
+            pend["flags"] = 2  # pending
+            post = np.zeros(BATCH, dtype=TRANSFER_DTYPE)
+            post["id_lo"] = np.arange(base + BATCH, base + 2 * BATCH, dtype=np.uint64)
+            post["pending_id_lo"] = pend["id_lo"]
+            post["flags"] = 4  # post_pending_transfer
+            batches += [pend, post]
+        ts = run_batches("two_phase_tps", ledger, ts, batches, warmup=2)
+
+    # 3. linked chains: every batch is chains of 4 (exact serial tier)
+    with stage("cfg_chains"):
+        ledger, ts = fresh()
+        batches = []
+        for g in range(3):
+            b = build_transfers(rng, 1 + g * BATCH, BATCH)
+            b["flags"] = 1  # linked
+            b["flags"][3::4] = 0  # chain terminators every 4th event
+            b["flags"][-1] = 0
+            batches.append(b)
+        ts = run_batches("linked_chains_tps", ledger, ts, batches)
+
+    # 4. balancing: balancing_debit over funded accounts (exact serial tier)
+    with stage("cfg_balancing"):
+        ledger, ts = fresh()
+        seed_batch = build_transfers(rng, 1, BATCH)  # fund accounts first
+        ts += BATCH
+        ledger.execute_async(Operation.create_transfers, ts, seed_batch)
+        batches = []
+        for g in range(3):
+            b = build_transfers(rng, 1 + (g + 1) * BATCH, BATCH)
+            b["flags"] = 16  # balancing_debit
+            batches.append(b)
+        ts = run_batches("balancing_tps", ledger, ts, batches)
+
+    # 5. mixed: ~94% simple transfers + ~6% two-phase residue -> the
+    # conflict-partitioned middle tier (fast majority + compacted serial
+    # residue)
+    with stage("cfg_mixed"):
+        ledger, ts = fresh()
+        pend0 = build_transfers(rng, 1, BATCH)
+        pend0["flags"] = 2
+        # keep pending accounts in a reserved low range, disjoint from the
+        # fast majority below
+        pend0["debit_account_id_lo"] = 1 + (np.arange(BATCH) % 500)
+        pend0["credit_account_id_lo"] = 501 + (np.arange(BATCH) % 500)
+        ts += BATCH
+        ledger.execute_async(Operation.create_transfers, ts, pend0)
+        batches = []
+        n_res = BATCH // 16  # ~512 residue events
+        for g in range(4):
+            b = build_transfers(rng, 1 + (g + 1) * BATCH, BATCH)
+            # fast majority over accounts > 1000
+            dr = rng.integers(1001, N_ACCOUNTS + 1, size=BATCH, dtype=np.uint64)
+            off = rng.integers(1, N_ACCOUNTS - 1001, size=BATCH, dtype=np.uint64)
+            b["debit_account_id_lo"] = dr
+            b["credit_account_id_lo"] = (dr - 1001 + off) % (N_ACCOUNTS - 1000) + 1001
+            # residue: posts of the pending batch, scattered through the lanes
+            res_lanes = rng.choice(BATCH, size=n_res, replace=False)
+            b["pending_id_lo"][res_lanes] = pend0["id_lo"][g * n_res:(g + 1) * n_res]
+            b["debit_account_id_lo"][res_lanes] = 0
+            b["credit_account_id_lo"][res_lanes] = 0
+            b["amount_lo"][res_lanes] = 0
+            b["flags"][res_lanes] = 4  # post
+            batches.append(b)
+        ts = run_batches("mixed_split_tps", ledger, ts, batches)
+        out["split_stats"] = dict(ledger.hazards.split_stats)
+
+    return out
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -301,6 +442,9 @@ def main() -> None:
         )
         ledger.check_fault()
 
+    # =========== tracked configs (BASELINE.json's five workloads) =======
+    configs = bench_tracked_configs(stage)
+
     lat = np.percentile(lat_ms if lat_ms else [float("nan")], [0, 25, 50, 75, 100])
     print(
         "stage times (s): "
@@ -325,6 +469,7 @@ def main() -> None:
                 "ingest_tps": round(ingest_tps, 1),
                 "ingest_note": f"host-upload path over the ~143 MiB/s tunnel, "
                 f"{n_ingest} transfers at 128 B each",
+                "configs": configs,
             }
         )
     )

@@ -343,6 +343,17 @@ class LedgerKernels:
         self.commit_accounts = jax.jit(
             self._commit_accounts, static_argnames=("mode",), donate_argnums=(0,)
         )
+        # Residue entry for the SPLIT executor: the serial scan over a
+        # compacted hazard residue with explicit per-event timestamps.
+        self.commit_transfers_residue = jax.jit(
+            lambda state, ev, n: self._serial_transfers_core(
+                state, ev["rows"], ev["ts"], n
+            ),
+            donate_argnums=(0,),
+        )
+        self.merge_results = jax.jit(
+            lambda r_fast, r_res, idx: r_fast.at[idx].set(r_res, mode="drop")
+        )
         self.lookup_accounts = jax.jit(self._lookup_accounts)
         self.lookup_transfers = jax.jit(self._lookup_transfers)
 
@@ -363,6 +374,8 @@ class LedgerKernels:
         e = unpack_transfer(rows_b)
         lane = jnp.arange(B, dtype=I32)
         valid = lane < n
+        if "mask" in ev:  # split executor: the hazard residue runs serial
+            valid = valid & ev["mask"]
         ts_vec = timestamp - n.astype(U64) + lane.astype(U64) + jnp.uint64(1)
         e_a = {**e, "ts": ts_vec}
 
@@ -467,6 +480,16 @@ class LedgerKernels:
     def _serial_transfers(self, state, ev, n, timestamp):
         rows_b = ev["rows"]
         B = rows_b.shape[0]
+        lane = jnp.arange(B, dtype=I32)
+        ts_vec = timestamp - n.astype(U64) + lane.astype(U64) + jnp.uint64(1)
+        return self._serial_transfers_core(state, rows_b, ts_vec, n)
+
+    def _serial_transfers_core(self, state, rows_b, ts_vec, n):
+        """The exact scan. Timestamps are EXPLICIT per event: the full-batch
+        path passes timestamp-n+i+1; the split executor passes the residue
+        events' ORIGINAL batch timestamps (compaction must not change them).
+        """
+        B = rows_b.shape[0]
         lanes = jnp.arange(B, dtype=I32)
         a_dump, t_dump = self.a_dump, self.t_dump
         tomb_row = _TOMB_ROW  # numpy: embeds as a literal
@@ -505,7 +528,7 @@ class LedgerKernels:
         def step(carry, x):
             (acct_rows, xfer_rows, fulfill, results, undo, chain_start,
              chain_broken, commit_ts, probe_bad) = carry
-            i, row_e = x
+            i, row_e, ts = x
             e = unpack_transfer(row_e)
             active = i < n
             linked = active & ((e["flags"] & jnp.uint32(F_LINKED)) != 0)
@@ -515,7 +538,6 @@ class LedgerKernels:
             in_chain = chain_start >= 0
             is_last = i == (n - 1)
 
-            ts = timestamp - n.astype(U64) + i.astype(U64) + jnp.uint64(1)
             e_a = {**e, "ts": ts}
 
             lad = validate.Ladder(jnp.uint32(0))
@@ -648,7 +670,9 @@ class LedgerKernels:
             cw = jnp.where(ok, tgt_cr_slot, a_dump)
             acct_rows = acct_rows.at[dw].set(pack_account(tdr))
             acct_rows = acct_rows.at[cw].set(pack_account(tcr))
-            commit_ts = jnp.where(ok, ts, commit_ts)
+            # max, not set: the split executor's fast half may already have
+            # committed later-lane timestamps
+            commit_ts = jnp.where(ok, jnp.maximum(commit_ts, ts), commit_ts)
 
             # --- undo log entry ---
             kind = jnp.where(
@@ -735,7 +759,7 @@ class LedgerKernels:
             ), None
 
         (acct_rows, xfer_rows, fulfill, results, undo, _, _, commit_ts,
-         probe_bad), _ = jax.lax.scan(step, carry0, (lanes, rows_b))
+         probe_bad), _ = jax.lax.scan(step, carry0, (lanes, rows_b, ts_vec))
         ok_n = jnp.sum((results == 0) & (lanes < n)).astype(U64)
         # Ever-applied inserts (rolled-back ones leave tombstones): the
         # undo log's kind stays set through rollback — exactly the count
@@ -964,6 +988,13 @@ class HazardTracker:
         # validation against pre-batch balances — which the vectorized ladder
         # computes exactly.
         self.amount_sum = 0
+        # Conservative superset of pending transfers ever submitted:
+        # id -> (debit lo-limb, credit lo-limb). The split executor needs
+        # the accounts a post/void will touch (they are the PENDING's
+        # accounts, not the event's own) to keep the fast/serial account
+        # sets disjoint.
+        self.pending_accounts: dict[int, tuple[int, int]] = {}
+        self.split_stats = {"fast": 0, "serial": 0, "split": 0}
 
     @staticmethod
     def has_dup_ids(arr: np.ndarray) -> bool:
@@ -982,49 +1013,190 @@ class HazardTracker:
         ).view("V16")
         return len(np.unique(ids)) < len(arr)
 
-    def transfers_hazard(self, arr: np.ndarray) -> bool:
-        """True if the batch needs the serial tier. Exact conditions."""
-        # Exact overflow bound: sum every amount as a Python int (u64 column
-        # sums cannot wrap: 2^13 values < 2^32 per 32-bit half). Counted for
-        # EVERY batch, serial-tier ones included, so the running sum is an
-        # upper bound on any balance the store can hold: posts move pending
-        # to posted, voids remove, balancing clamps to available <= sum.
+    @staticmethod
+    def _batch_amount_sum(arr: np.ndarray) -> int:
+        """Exact u128 sum of every amount in the batch (u64 column sums
+        cannot wrap: 2^13 values < 2^32 per 32-bit half)."""
         lo, hi = arr["amount_lo"], arr["amount_hi"]
-        batch_sum = (
+        return (
             int(np.sum(lo & np.uint64(0xFFFFFFFF), dtype=np.uint64))
             + (int(np.sum(lo >> np.uint64(32), dtype=np.uint64)) << 32)
             + ((int(np.sum(hi & np.uint64(0xFFFFFFFF), dtype=np.uint64))
                 + (int(np.sum(hi >> np.uint64(32), dtype=np.uint64)) << 32)) << 64)
         )
-        self.amount_sum += batch_sum
+
+    def transfers_hazard(self, arr: np.ndarray) -> bool:
+        """True if the batch needs the serial tier (all-or-nothing variant;
+        the sharded ledger uses this — the single-chip ledger uses split()).
+        The running amount sum is an upper bound on any balance the store
+        can hold: posts move pending to posted, voids remove, balancing
+        clamps to available <= sum — counted for EVERY batch."""
+        self.amount_sum += self._batch_amount_sum(arr)
         if self.amount_sum >= (1 << 127):
             return True  # conservative: overflow no longer provably impossible
         if (arr["flags"] & _SLOW_FLAGS).any():
             return True
         if self.has_dup_ids(arr):
             return True
-        if self.limit_account_ids:
-            lo2 = np.concatenate(
-                [arr["debit_account_id_lo"], arr["credit_account_id_lo"]]
-            )
-            hi2 = np.concatenate(
-                [arr["debit_account_id_hi"], arr["credit_account_id_hi"]]
-            )
-            # Vectorized membership: candidate lanes whose lo limb appears in
-            # the sorted limit-lo column, then confirm the hi limb.
-            pos = np.searchsorted(self._limit_lo, lo2)
-            pos_c = np.minimum(pos, len(self._limit_lo) - 1)
-            cand = (self._limit_lo[pos_c] == lo2)
-            if cand.any():
-                for lo_, hi_ in zip(lo2[cand], hi2[cand]):
-                    if (int(lo_) | (int(hi_) << 64)) in self.limit_account_ids:
-                        return True
+        if self.limit_account_ids and self._touches_limit(arr).any():
+            return True
         return False
 
     def accounts_hazard(self, arr: np.ndarray) -> bool:
         if (arr["flags"] & validate.A_LINKED).any():
             return True
         return self.has_dup_ids(arr)
+
+    # ------------------------------------------------------------------
+    # the SPLIT decision (middle tier): partition a batch into a fast-
+    # eligible majority and a hazard residue whose accounts/ids are provably
+    # disjoint, so running fast-then-residue preserves exact semantics
+    # ------------------------------------------------------------------
+
+    def note_pending(self, arr: np.ndarray) -> None:
+        pen = (arr["flags"] & np.uint16(F_PENDING)) != 0
+        if pen.any():
+            for idl, idh, dl, cl in zip(
+                arr["id_lo"][pen], arr["id_hi"][pen],
+                arr["debit_account_id_lo"][pen],
+                arr["credit_account_id_lo"][pen],
+            ):
+                self.pending_accounts[int(idl) | (int(idh) << 64)] = (
+                    int(dl), int(cl),
+                )
+        # Bound the registry: a pending referenced by a post/void cannot be
+        # meaningfully referenced again (idempotency paths fail without
+        # touching balances) — evict it; a later stray reference degrades
+        # that batch to serial, which is always sound.
+        pv = (arr["flags"] & np.uint16(F_POST | F_VOID)) != 0
+        if pv.any():
+            for pl, ph in zip(
+                arr["pending_id_lo"][pv], arr["pending_id_hi"][pv]
+            ):
+                self.pending_accounts.pop(int(pl) | (int(ph) << 64), None)
+
+    def split(self, arr: np.ndarray):
+        """Per-batch tier decision: ("fast", None) | ("serial", None) |
+        ("split", slow_mask). The split is SOUND when reordering the fast
+        subset before the residue cannot change any event's outcome:
+
+        - residue events: serial-only flags (linked/post/void/balancing),
+          whole chain runs (linked run + its terminator), duplicate ids
+          (conservative: hash groups), events touching limit accounts;
+        - the two subsets share NO accounts (fixpoint over lo-limb account
+          sets, pending-target accounts of referenced pendings included) and
+          NO id references (fast ids never equal residue pending_ids);
+        - overflow risk or an unknown pending reference degrades the whole
+          batch to serial (conservative)."""
+        # exact overflow bound, counted once per batch (see transfers_hazard)
+        self.amount_sum += self._batch_amount_sum(arr)
+        if self.amount_sum >= (1 << 127):
+            self.split_stats["serial"] += 1
+            return "serial", None
+
+        B = len(arr)
+        flags = arr["flags"]
+        slow = (flags & np.uint16(_SLOW_FLAGS)) != 0
+        # whole chain runs: a linked run's terminator is the event AFTER it
+        linked = (flags & np.uint16(F_LINKED)) != 0
+        in_chain = linked.copy()
+        in_chain[1:] |= linked[:-1]
+        slow |= in_chain
+        # duplicate ids: conservative hash groups (collisions only add lanes)
+        with np.errstate(over="ignore"):
+            h = arr["id_lo"] ^ (arr["id_hi"] * np.uint64(0x9E3779B97F4A7C15))
+        order = np.argsort(h, kind="stable")
+        hs = h[order]
+        dup_sorted = np.zeros(B, dtype=bool)
+        if B > 1:
+            eq = hs[1:] == hs[:-1]
+            dup_sorted[1:] |= eq
+            dup_sorted[:-1] |= eq
+        dup = np.zeros(B, dtype=bool)
+        dup[order] = dup_sorted
+        slow |= dup
+        # limit-account touches
+        if self.limit_account_ids:
+            slow |= self._touches_limit(arr)
+
+        if slow.all():  # nothing could go fast: skip the pv/fixpoint work
+            self.split_stats["serial"] += 1
+            return "serial", None
+
+        # pending references of residue post/voids
+        extra_acc: list[int] = []
+        pv = (flags & np.uint16(F_POST | F_VOID)) != 0
+        if pv.any():
+            pid_lo = arr["pending_id_lo"][pv]
+            pid_hi = arr["pending_id_hi"][pv]
+            batch_ids = {
+                int(a) | (int(b) << 64)
+                for a, b in zip(arr["id_lo"], arr["id_hi"])
+            }
+            pid_set = set()
+            for a, b in zip(pid_lo, pid_hi):
+                pid = int(a) | (int(b) << 64)
+                pid_set.add(pid)
+                known = self.pending_accounts.get(pid)
+                if known is not None:
+                    extra_acc.extend(known)
+                elif pid not in batch_ids and pid not in (0, (1 << 128) - 1):
+                    # referenced pending we know nothing about (e.g. created
+                    # before a restart without registry restore): punt
+                    self.split_stats["serial"] += 1
+                    return "serial", None
+            # fast events whose id a residue post/void references
+            if pid_set:
+                ref = np.fromiter(
+                    (
+                        (int(a) | (int(b) << 64)) in pid_set
+                        for a, b in zip(arr["id_lo"], arr["id_hi"])
+                    ),
+                    dtype=bool, count=B,
+                )
+                slow |= ref
+
+        # account-disjointness fixpoint (lo limbs; collisions conservative)
+        dr = arr["debit_account_id_lo"].astype(np.uint64)
+        cr = arr["credit_account_id_lo"].astype(np.uint64)
+        extra = np.array(extra_acc, dtype=np.uint64)
+        for _ in range(64):
+            if slow.all():
+                break
+            r_acc = np.unique(np.concatenate([dr[slow], cr[slow], extra]))
+            move = ~slow & (np.isin(dr, r_acc) | np.isin(cr, r_acc))
+            if not move.any():
+                break
+            slow |= move
+        else:
+            self.split_stats["serial"] += 1
+            return "serial", None
+
+        n_fast = int((~slow).sum())
+        if not slow.any():
+            self.split_stats["fast"] += 1
+            return "fast", None
+        if n_fast < max(8, B // 8):
+            # too little fast work to pay for two dispatches
+            self.split_stats["serial"] += 1
+            return "serial", None
+        self.split_stats["split"] += 1
+        return "split", slow
+
+    def _touches_limit(self, arr: np.ndarray) -> np.ndarray:
+        lo2 = np.stack([arr["debit_account_id_lo"], arr["credit_account_id_lo"]])
+        hi2 = np.stack([arr["debit_account_id_hi"], arr["credit_account_id_hi"]])
+        pos = np.searchsorted(self._limit_lo, lo2)
+        pos_c = np.minimum(pos, len(self._limit_lo) - 1)
+        cand = self._limit_lo[pos_c] == lo2
+        out = np.zeros(arr.shape[0], dtype=bool)
+        if cand.any():
+            for side in range(2):
+                for i in np.nonzero(cand[side])[0]:
+                    key = int(lo2[side][i]) | (int(hi2[side][i]) << 64)
+                    if key in self.limit_account_ids:
+                        out[i] = True
+        return out
 
     def note_limit_accounts(self, arr: np.ndarray) -> None:
         limit_bits = validate.A_DR_LIMIT | validate.A_CR_LIMIT
@@ -1216,13 +1388,20 @@ class DeviceLedger(HostLedgerBase):
                     "grow ConfigProcess.transfer_slots_log2"
                 )
             arr = events if isinstance(events, np.ndarray) else types.transfers_to_np(events)
-            mode = self.mode
-            if mode == "auto":
-                mode = "serial" if self.hazards.transfers_hazard(arr) else "fast"
-            batch = transfers_to_batch(arr, n_pad)
-            self.state, results = self.kernels.commit_transfers(
-                self.state, batch, nn, ts, mode=mode
-            )
+            if self.mode == "auto":
+                decision, slow_mask = self.hazards.split(arr)
+            else:  # forced tier (parity tests); the amount bound is unused
+                decision, slow_mask = self.mode, None
+            self.hazards.note_pending(arr)
+            if decision == "split":
+                results = self._execute_split(
+                    arr, n, n_pad, nn, ts, timestamp, slow_mask
+                )
+            else:
+                batch = transfers_to_batch(arr, n_pad)
+                self.state, results = self.kernels.commit_transfers(
+                    self.state, batch, nn, ts, mode=decision
+                )
             self._xfer_used += n
         elif operation == Operation.create_accounts:
             if self._acct_used + n > self._acct_limit:
@@ -1246,6 +1425,38 @@ class DeviceLedger(HostLedgerBase):
         return PendingBatch(
             operation, n, results, flags=arr["flags"].copy()
         )
+
+    def _execute_split(self, arr, n, n_pad, nn, ts, timestamp: int, slow_mask):
+        """The middle tier: the fast-eligible majority runs vectorized with
+        the residue lanes masked out, then the hazard residue runs through
+        the exact serial scan COMPACTED (cost scales with residue size, not
+        batch size) with its events' original timestamps; results scatter
+        back to original lanes. Sound by the split invariants proven in
+        HazardTracker.split."""
+        mask_np = np.zeros(n_pad, dtype=bool)
+        mask_np[:n] = ~slow_mask
+        batch = transfers_to_batch(arr, n_pad)
+        batch["mask"] = jnp.asarray(mask_np)
+        self.state, r_fast = self.kernels.commit_transfers(
+            self.state, batch, nn, ts, mode="fast"
+        )
+
+        idx = np.nonzero(slow_mask)[0]
+        n2 = len(idx)
+        pad2 = _next_pow2(n2)
+        rows2 = np.zeros((pad2, ROW_WORDS), dtype=np.uint32)
+        rows2[:n2] = arr.view(np.uint32).reshape(len(arr), ROW_WORDS)[idx]
+        ts2 = np.zeros(pad2, dtype=np.uint64)
+        base = timestamp - n + 1  # first event's timestamp (host int: no sync)
+        ts2[:n2] = np.uint64(base) + idx.astype(np.uint64)
+        self.state, r_res = self.kernels.commit_transfers_residue(
+            self.state,
+            {"rows": jnp.asarray(rows2), "ts": jnp.asarray(ts2)},
+            jnp.int32(n2),
+        )
+        idx_pad = np.full(pad2, n_pad, dtype=np.int32)  # OOB -> dropped
+        idx_pad[:n2] = idx
+        return self.kernels.merge_results(r_fast, r_res, jnp.asarray(idx_pad))
 
     def check_fault(self) -> None:
         """Raise if the device hit the fault protocol (see module docstring).
