@@ -66,10 +66,24 @@ def test_split_mixed_two_phase_batch():
         transfers.append(Transfer(id=200 + i, debit_account_id=a,
                                   credit_account_id=b, amount=1 + i,
                                   ledger=1, code=1))
-    # the residue: post of the pending (touches accounts 1,2)
+    # the post of the pending is itself fast-eligible now (fast_pv)
     transfers.insert(7, Transfer(id=300, pending_id=100, amount=30,
                                  flags=int(TransferFlags.post_pending_transfer)))
-    ts = _check(oracle, dev, ts, transfers, expect_decision="split")
+    ts = _check(oracle, dev, ts, transfers, expect_decision="fast_pv")
+    assert dev.hazards.split_stats.get("fast_pv", 0) >= 1
+
+    # force a real SPLIT: add a linked chain on its own accounts
+    transfers2 = [
+        Transfer(id=310, debit_account_id=3, credit_account_id=4, amount=5,
+                 ledger=1, code=1, flags=int(TransferFlags.linked)),
+        Transfer(id=311, debit_account_id=3, credit_account_id=4, amount=6,
+                 ledger=1, code=1),
+    ] + [
+        Transfer(id=320 + i, debit_account_id=5 + i % 8,
+                 credit_account_id=13 + i % 8, amount=2 + i, ledger=1, code=1)
+        for i in range(16)
+    ]
+    ts = _check(oracle, dev, ts, transfers2, expect_decision="split")
     assert dev.hazards.split_stats["split"] >= 1
 
 
@@ -113,11 +127,20 @@ def test_split_balancing_residue():
     ts = _check(oracle, dev, ts, transfers)
 
 
-def test_split_unknown_pending_ref_degrades_serial():
-    """A post referencing a pending the tracker never saw (e.g. created
-    before a restart) must degrade the whole batch to serial."""
+def test_split_unknown_pending_ref_joins_residue():
+    """In a PARTIAL split, a post referencing a pending the tracker never
+    saw (e.g. created before a restart) cannot prove account-disjointness
+    from the fast half — it must join the serial residue. (In a full-batch
+    fast_pv there is no disjointness requirement: the kernel reads the
+    pending's truth from the table.)"""
     tracker = HazardTracker()
     transfers = [
+        # a chain -> guarantees a residue exists
+        Transfer(id=890, debit_account_id=30, credit_account_id=31, amount=1,
+                 ledger=1, code=1, flags=int(TransferFlags.linked)),
+        Transfer(id=891, debit_account_id=30, credit_account_id=31, amount=1,
+                 ledger=1, code=1),
+    ] + [
         Transfer(id=900 + i, debit_account_id=5 + i, credit_account_id=6 + i,
                  amount=2, ledger=1, code=1)
         for i in range(0, 18, 2)
@@ -125,8 +148,76 @@ def test_split_unknown_pending_ref_degrades_serial():
         Transfer(id=950, pending_id=424242,  # a pending we never saw
                  flags=int(TransferFlags.post_pending_transfer)),
     ]
-    decision, _ = tracker.split(transfers_to_np(transfers))
-    assert decision == "serial"
+    decision, mask = tracker.split(transfers_to_np(transfers))
+    assert decision == "split"
+    assert mask[0] and mask[1]  # the chain
+    assert mask[-1]  # the unknown-pending post joined the residue
+
+
+def test_fast_pv_pure_post_batch():
+    """A whole batch of posts/voids of distinct prior pendings runs the
+    VECTORIZED fast_pv tier (no serial scan), bit-exact against the oracle."""
+    oracle, dev, ts = _setup_pair()
+    # 12 pendings in one (fast) batch
+    pends = [
+        Transfer(id=1000 + i, debit_account_id=1 + i % 10,
+                 credit_account_id=11 + i % 10, amount=100 + i, ledger=1,
+                 code=1, flags=int(TransferFlags.pending))
+        for i in range(12)
+    ]
+    ts = _check(oracle, dev, ts, pends, expect_decision="fast")
+    # posts (partial amounts), voids, one bad reference, one expired-free mix
+    resolves = [
+        Transfer(id=2000 + i, pending_id=1000 + i, amount=50 + i,
+                 flags=int(TransferFlags.post_pending_transfer))
+        for i in range(6)
+    ] + [
+        Transfer(id=2100 + i, pending_id=1006 + i,
+                 flags=int(TransferFlags.void_pending_transfer))
+        for i in range(4)
+    ] + [
+        Transfer(id=2200, pending_id=999999,  # not found
+                 flags=int(TransferFlags.post_pending_transfer)),
+        Transfer(id=2201, pending_id=0,  # must_not_be_zero
+                 flags=int(TransferFlags.void_pending_transfer)),
+    ]
+    ts = _check(oracle, dev, ts, resolves, expect_decision="fast_pv")
+    assert dev.hazards.split_stats.get("fast_pv", 0) >= 1
+    # double-resolve attempts (already posted/voided) go serial (dup refs
+    # would be order-dependent) — still exact
+    again = [
+        Transfer(id=2300, pending_id=1000, amount=10,
+                 flags=int(TransferFlags.post_pending_transfer)),
+        Transfer(id=2301, pending_id=1000, amount=10,
+                 flags=int(TransferFlags.post_pending_transfer)),
+    ]
+    ts = _check(oracle, dev, ts, again)
+
+
+def test_fast_pv_mixed_with_simple_shared_accounts():
+    """fast_pv with posts and simple transfers hitting the SAME accounts in
+    one batch: the signed accumulator must net them exactly."""
+    oracle, dev, ts = _setup_pair()
+    pends = [
+        Transfer(id=3000 + i, debit_account_id=1, credit_account_id=2,
+                 amount=40 + i, ledger=1, code=1,
+                 flags=int(TransferFlags.pending))
+        for i in range(4)
+    ]
+    ts = _check(oracle, dev, ts, pends)
+    mixed = [
+        Transfer(id=3100, pending_id=3000, amount=15,
+                 flags=int(TransferFlags.post_pending_transfer)),
+        Transfer(id=3101, debit_account_id=1, credit_account_id=2, amount=7,
+                 ledger=1, code=1),
+        Transfer(id=3102, pending_id=3001,
+                 flags=int(TransferFlags.void_pending_transfer)),
+        Transfer(id=3103, debit_account_id=2, credit_account_id=1, amount=3,
+                 ledger=1, code=1),
+        Transfer(id=3104, pending_id=3002, amount=42,
+                 flags=int(TransferFlags.post_pending_transfer)),
+    ]
+    ts = _check(oracle, dev, ts, mixed, expect_decision="fast_pv")
 
 
 @pytest.mark.parametrize("seed", [21, 22])

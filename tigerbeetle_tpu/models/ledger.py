@@ -75,9 +75,13 @@ U64 = jnp.uint64
 U32 = jnp.uint32
 I32 = jnp.int32
 
-# Flags that force the serial tier (linked | post | void | balancing_debit |
-# balancing_credit). Only no-flag and pending-only events are fast-tier-safe.
+# Flags that force the serial tier in the ALL-OR-NOTHING hazard check
+# (sharded ledger): linked | post | void | balancing_debit |
+# balancing_credit. Only no-flag and pending-only events are fast-tier-safe.
 _SLOW_FLAGS = 0b111101
+# The split executor's slow flags: post/void are fast-eligible there (the
+# fast_pv kernel handles them); linked and balancing remain serial-only.
+_SPLIT_SLOW_FLAGS = 0b110001
 
 ROW_WORDS = 32  # 128-byte wire rows as u32 words
 
@@ -295,6 +299,44 @@ def _fold_digits(row32, acc32):
     return jnp.stack(new_words, axis=-1), overflow
 
 
+def _fold_digits_signed(row32, acc32):
+    """Signed variant of _fold_digits for the post/void fast tier: the
+    accumulator lanes hold mod-2^32 sums of SIGNED 16-bit digits
+    (subtractions contribute (-d) mod 2^32). |true sum| <= 8192*65535 <
+    2^30, so bitcasting a lane to i32 recovers the exact signed value; the
+    fold then runs in i64 with arithmetic-shift carries. A nonzero final
+    carry means overflow (positive) or underflow (negative — impossible for
+    host-proven batches: every subtraction is a distinct committed pending's
+    amount already included in the balance; kept as the device backstop).
+    Returns (new_row, bad)."""
+    import jax
+
+    new_words = [row32[..., i] for i in range(ROW_WORDS)]
+    bad = jnp.zeros(row32.shape[:-1], dtype=bool)
+    I64 = jnp.int64
+    for field in range(4):  # dp, dpo, cp, cpo at words 4+4f .. 7+4f
+        w0 = 4 + 4 * field
+        carry = jnp.zeros(row32.shape[:-1], dtype=I64)
+        for k in range(4):
+            w = row32[..., w0 + k]
+            d_lo = jax.lax.bitcast_convert_type(
+                acc32[..., 8 * field + 2 * k], jnp.int32
+            ).astype(I64)
+            d_hi = jax.lax.bitcast_convert_type(
+                acc32[..., 8 * field + 2 * k + 1], jnp.int32
+            ).astype(I64)
+            s_lo = (w & jnp.uint32(0xFFFF)).astype(I64) + d_lo + carry
+            carry = s_lo >> jnp.int64(16)
+            s_hi = (w >> jnp.uint32(16)).astype(I64) + d_hi + carry
+            carry = s_hi >> jnp.int64(16)
+            new_words[w0 + k] = (
+                (s_lo & jnp.int64(0xFFFF))
+                | ((s_hi & jnp.int64(0xFFFF)) << jnp.int64(16))
+            ).astype(U32)
+        bad = bad | (carry != 0)
+    return jnp.stack(new_words, axis=-1), bad
+
+
 def _combined_overflow(new_rows_t):
     """Per-lane carry of the COMBINED debits_pending+debits_posted and
     credits_pending+credits_posted sums of folded account rows. Codes 51/52
@@ -362,12 +404,15 @@ class LedgerKernels:
     # ------------------------------------------------------------------
 
     def _commit_transfers(self, state, ev, n, timestamp, mode: str = "fast"):
-        """Returns (state', results u32 [B]). `mode` is chosen by the HOST
-        ("fast" only for host-proven hazard-free batches — see
-        DeviceLedger._transfers_hazard)."""
+        """Returns (state', results u32 [B]). `mode` is chosen by the HOST:
+        "fast" for host-proven hazard-free batches, "fast_pv" when the batch
+        additionally carries fast-eligible post/void events (distinct,
+        registry-known pendings — see HazardTracker.split), "serial" for
+        the exact scan."""
         if mode == "serial":
             return self._serial_transfers(state, ev, n, timestamp)
-        assert mode == "fast", mode
+        assert mode in ("fast", "fast_pv"), mode
+        pv_mode = mode == "fast_pv"
 
         rows_b = ev["rows"]
         B = rows_b.shape[0]
@@ -398,13 +443,47 @@ class LedgerKernels:
         r, amt_lo, amt_hi = validate.validate_simple_transfer(
             r0, e_a, dr, cr, dr_found, cr_found, ex, ex_found
         )
-        r = jnp.where(valid, r, jnp.uint32(0))
-        ok = valid & (r == 0)
 
         # Unresolved probes among lanes that matter -> abort the whole batch
         # (fault protocol; writes below are gated on `proceed`).
         valid2 = jnp.concatenate([valid, valid])
         probe_bad = jnp.any(valid2 & ~both_res) | jnp.any(valid & ~ex_res)
+
+        if pv_mode:
+            # pending-transfer wave: p row + fulfill, then p's accounts
+            is_pv = (e["flags"] & jnp.uint32(F_POST | F_VOID)) != 0
+            p_slot, p_found, p_res = ht.lookup(
+                rows_b[:, 16:20], xfer_rows, self.t_log2
+            )
+            p = unpack_transfer(xfer_rows[p_slot])
+            p["fulfill"] = state["fulfill"][p_slot]
+            p_both_k4 = jnp.concatenate([
+                key4_from_fields({"id_lo": p["dr_lo"], "id_hi": p["dr_hi"]}),
+                key4_from_fields({"id_lo": p["cr_lo"], "id_hi": p["cr_hi"]}),
+            ], axis=0)
+            pb_slot, pb_found, pb_res = ht.lookup(
+                p_both_k4, acct_rows, self.a_log2
+            )
+            pb_rows = acct_rows[pb_slot]
+            pdr_slot, pcr_slot = pb_slot[:B], pb_slot[B:]
+            pdr_row, pcr_row = pb_rows[:B], pb_rows[B:]
+            r_pv, amt_pv_lo, amt_pv_hi = validate.validate_post_void(
+                r0, e_a, p, p_found, ex, ex_found
+            )
+            r = jnp.where(is_pv, r_pv, r)
+            amt_lo = jnp.where(is_pv, amt_pv_lo, amt_lo)
+            amt_hi = jnp.where(is_pv, amt_pv_hi, amt_hi)
+            pvv = valid & is_pv
+            probe_bad = (
+                probe_bad
+                | jnp.any(pvv & ~p_res)
+                | jnp.any(jnp.concatenate([pvv, pvv]) & ~pb_res)
+            )
+        else:
+            is_pv = jnp.zeros(B, dtype=bool)
+
+        r = jnp.where(valid, r, jnp.uint32(0))
+        ok = valid & (r == 0)
 
         # Claim insert slots (pure claim phase; rows written below, after
         # gating). Keys are batch-unique and absent — host-proven.
@@ -419,19 +498,41 @@ class LedgerKernels:
         digits = _amount_digits(amt_lo, amt_hi)  # [B, 8]
         pending = ((e["flags"] & jnp.uint32(F_PENDING)) != 0)
         zeros8 = jnp.zeros_like(digits)
-        pend8 = jnp.where(pending[:, None], digits, zeros8)
-        post8 = jnp.where(pending[:, None], zeros8, digits)
+        if pv_mode:
+            # signed digits: post/void SUBTRACTS the pending's amount from
+            # the pending balances of the PENDING's accounts, and a post
+            # adds the resolved amount to the posted balances
+            is_post = is_pv & ((e["flags"] & jnp.uint32(F_POST)) != 0)
+            p_digits = _amount_digits(p["amt_lo"], p["amt_hi"])
+            neg_p = jnp.zeros_like(p_digits) - p_digits  # mod 2^32
+            simple = ~is_pv
+            pend8 = jnp.where((simple & pending)[:, None], digits, zeros8) + \
+                jnp.where(is_pv[:, None], neg_p, zeros8)
+            post8 = jnp.where((simple & ~pending)[:, None], digits, zeros8) + \
+                jnp.where(is_post[:, None], digits, zeros8)
+            dr_slot_eff = jnp.where(is_pv, pdr_slot, dr_slot)
+            cr_slot_eff = jnp.where(is_pv, pcr_slot, cr_slot)
+            dr_row_eff = jnp.where(is_pv[:, None], pdr_row, dr_row)
+            cr_row_eff = jnp.where(is_pv[:, None], pcr_row, cr_row)
+        else:
+            pend8 = jnp.where(pending[:, None], digits, zeros8)
+            post8 = jnp.where(pending[:, None], zeros8, digits)
+            dr_slot_eff, cr_slot_eff = dr_slot, cr_slot
+            dr_row_eff, cr_row_eff = dr_row, cr_row
         upd_dr = jnp.concatenate([pend8, post8, zeros8, zeros8], axis=-1)  # [B,32]
         upd_cr = jnp.concatenate([zeros8, zeros8, pend8, post8], axis=-1)
         slots_t = jnp.concatenate([
-            jnp.where(ok, dr_slot, self.a_dump),
-            jnp.where(ok, cr_slot, self.a_dump),
+            jnp.where(ok, dr_slot_eff, self.a_dump),
+            jnp.where(ok, cr_slot_eff, self.a_dump),
         ])
         upd = jnp.concatenate([upd_dr, upd_cr], axis=0)  # [2B, 32]
         acc = state["bal_acc"].at[slots_t].add(upd)
         acc_t = acc[slots_t]  # [2B, 32]
-        old_rows_t = jnp.concatenate([dr_row, cr_row], axis=0)
-        new_rows_t, over_t = _fold_digits(old_rows_t, acc_t)
+        old_rows_t = jnp.concatenate([dr_row_eff, cr_row_eff], axis=0)
+        if pv_mode:
+            new_rows_t, over_t = _fold_digits_signed(old_rows_t, acc_t)
+        else:
+            new_rows_t, over_t = _fold_digits(old_rows_t, acc_t)
         # Device-side backstop for the host's overflow bound (codes 51/52
         # combined-sum carries included — see _combined_overflow).
         over_bad = jnp.any(
@@ -453,11 +554,48 @@ class LedgerKernels:
         proceed = fault == 0  # sticky: also no-ops every batch after a fault
 
         # --- application (every write gated on `proceed`) ---
-        ins_rows = _set_ts_words(rows_b, ts_vec)
+        if pv_mode:
+            # stored post/void rows inherit the pending's routing fields
+            # (reference: src/state_machine.zig:907-1014); vectorized form
+            # of the serial tier's row construction
+            def dflt128(t_lo, t_hi, q_lo, q_hi):
+                z = u128.is_zero(t_lo, t_hi)
+                return jnp.where(z, q_lo, t_lo), jnp.where(z, q_hi, t_hi)
+
+            t2_ud128 = dflt128(
+                e["ud128_lo"], e["ud128_hi"], p["ud128_lo"], p["ud128_hi"]
+            )
+            ins = {
+                "id_lo": e["id_lo"], "id_hi": e["id_hi"],
+                "dr_lo": jnp.where(is_pv, p["dr_lo"], e["dr_lo"]),
+                "dr_hi": jnp.where(is_pv, p["dr_hi"], e["dr_hi"]),
+                "cr_lo": jnp.where(is_pv, p["cr_lo"], e["cr_lo"]),
+                "cr_hi": jnp.where(is_pv, p["cr_hi"], e["cr_hi"]),
+                "amt_lo": amt_lo, "amt_hi": amt_hi,
+                "pid_lo": e["pid_lo"], "pid_hi": e["pid_hi"],
+                "ud128_lo": jnp.where(is_pv, t2_ud128[0], e["ud128_lo"]),
+                "ud128_hi": jnp.where(is_pv, t2_ud128[1], e["ud128_hi"]),
+                "ud64": jnp.where(is_pv & (e["ud64"] == 0), p["ud64"], e["ud64"]),
+                "ud32": jnp.where(is_pv & (e["ud32"] == 0), p["ud32"], e["ud32"]),
+                "timeout": jnp.where(is_pv, jnp.uint32(0), e["timeout"]),
+                "ledger": jnp.where(is_pv, p["ledger"], e["ledger"]),
+                "code": jnp.where(is_pv, p["code"], e["code"]),
+                "flags": e["flags"],
+                "ts": ts_vec,
+            }
+            ins_rows = pack_transfer(ins)
+        else:
+            ins_rows = _set_ts_words(rows_b, ts_vec)
         acct2 = acct_rows.at[jnp.where(proceed, slots_t, self.a_dump)].set(new_rows_t)
         w = jnp.where(proceed & ok, ins_slots, self.t_dump)
         xfer2 = xfer_rows.at[w].set(ins_rows)
         fulfill = state["fulfill"].at[w].set(jnp.uint32(0))
+        if pv_mode:
+            # mark the pendings resolved (distinct pendings: no conflicts)
+            fw = jnp.where(proceed & ok & is_pv, p_slot, self.t_dump)
+            fulfill = fulfill.at[fw].set(
+                jnp.where(is_post, jnp.uint32(1), jnp.uint32(2))
+            )
         applied = proceed & jnp.any(ok)
         last_ts = jnp.max(jnp.where(ok, ts_vec, jnp.uint64(0)))
         return {
@@ -1076,18 +1214,16 @@ class HazardTracker:
                 self.pending_accounts.pop(int(pl) | (int(ph) << 64), None)
 
     def split(self, arr: np.ndarray):
-        """Per-batch tier decision: ("fast", None) | ("serial", None) |
-        ("split", slow_mask). The split is SOUND when reordering the fast
-        subset before the residue cannot change any event's outcome:
-
-        - residue events: serial-only flags (linked/post/void/balancing),
-          whole chain runs (linked run + its terminator), duplicate ids
-          (conservative: hash groups), events touching limit accounts;
-        - the two subsets share NO accounts (fixpoint over lo-limb account
-          sets, pending-target accounts of referenced pendings included) and
-          NO id references (fast ids never equal residue pending_ids);
-        - overflow risk or an unknown pending reference degrades the whole
-          batch to serial (conservative)."""
+        """Per-batch tier decision: ("fast"|"fast_pv"|"serial", None) or
+        ("split"|"split_pv", slow_mask). Post/void events are FAST-eligible
+        (the fast_pv kernel gathers the pending row + its accounts and
+        applies signed balance deltas) when their pending references are
+        distinct and not created within this batch; linked chains (whole
+        runs incl. terminators), balancing, duplicate-id groups, and
+        limit-account touches of SIMPLE lanes form the residue, closed
+        under shared accounts/ids by fixpoint. (Posts/voids perform no
+        limit checks — reference: src/state_machine.zig:907-1014 — so limit
+        accounts do not exclude them.)"""
         # exact overflow bound, counted once per batch (see transfers_hazard)
         self.amount_sum += self._batch_amount_sum(arr)
         if self.amount_sum >= (1 << 127):
@@ -1096,7 +1232,7 @@ class HazardTracker:
 
         B = len(arr)
         flags = arr["flags"]
-        slow = (flags & np.uint16(_SLOW_FLAGS)) != 0
+        slow = (flags & np.uint16(_SPLIT_SLOW_FLAGS)) != 0
         # whole chain runs: a linked run's terminator is the event AFTER it
         linked = (flags & np.uint16(F_LINKED)) != 0
         in_chain = linked.copy()
@@ -1105,60 +1241,90 @@ class HazardTracker:
         # duplicate ids: conservative hash groups (collisions only add lanes)
         with np.errstate(over="ignore"):
             h = arr["id_lo"] ^ (arr["id_hi"] * np.uint64(0x9E3779B97F4A7C15))
-        order = np.argsort(h, kind="stable")
-        hs = h[order]
-        dup_sorted = np.zeros(B, dtype=bool)
-        if B > 1:
-            eq = hs[1:] == hs[:-1]
-            dup_sorted[1:] |= eq
-            dup_sorted[:-1] |= eq
-        dup = np.zeros(B, dtype=bool)
-        dup[order] = dup_sorted
-        slow |= dup
-        # limit-account touches
+        slow |= self._dup_groups(h)
+        pv = (flags & np.uint16(F_POST | F_VOID)) != 0
+        # limit-account touches (simple lanes only: post/void is exempt)
         if self.limit_account_ids:
-            slow |= self._touches_limit(arr)
+            slow |= self._touches_limit(arr) & ~pv
 
-        if slow.all():  # nothing could go fast: skip the pv/fixpoint work
+        pv_live = pv & ~slow
+        extra_acc: list[int] = []
+        if pv_live.any():
+            # duplicate pending references are order-dependent (33/34 codes)
+            with np.errstate(over="ignore"):
+                hp = arr["pending_id_lo"] ^ (
+                    arr["pending_id_hi"] * np.uint64(0x9E3779B97F4A7C15)
+                )
+            hp = hp.copy()
+            hp[~pv] = np.uint64(0) - np.arange(1, B + 1)[~pv].astype(np.uint64)
+            slow |= self._dup_groups(hp) & pv
+            # a post/void of a pending CREATED IN THIS BATCH is order-
+            # dependent: both the reference and the creator go serial
+            # (conservative lo-limb matching)
+            pid_lo = arr["pending_id_lo"]
+            in_batch_ref = np.isin(pid_lo, arr["id_lo"]) & pv
+            if in_batch_ref.any():
+                slow |= in_batch_ref
+                slow |= np.isin(arr["id_lo"], pid_lo[in_batch_ref])
+            pv_live = pv & ~slow
+
+        if slow.all():
             self.split_stats["serial"] += 1
             return "serial", None
+        if not slow.any():
+            name = "fast_pv" if pv.any() else "fast"
+            self.split_stats[name] = self.split_stats.get(name, 0) + 1
+            return name, None
 
-        # pending references of residue post/voids
-        extra_acc: list[int] = []
-        pv = (flags & np.uint16(F_POST | F_VOID)) != 0
-        if pv.any():
-            pid_lo = arr["pending_id_lo"][pv]
-            pid_hi = arr["pending_id_hi"][pv]
-            batch_ids = {
-                int(a) | (int(b) << 64)
-                for a, b in zip(arr["id_lo"], arr["id_hi"])
-            }
-            pid_set = set()
-            for a, b in zip(pid_lo, pid_hi):
-                pid = int(a) | (int(b) << 64)
-                pid_set.add(pid)
-                known = self.pending_accounts.get(pid)
-                if known is not None:
-                    extra_acc.extend(known)
-                elif pid not in batch_ids and pid not in (0, (1 << 128) - 1):
-                    # referenced pending we know nothing about (e.g. created
-                    # before a restart without registry restore): punt
-                    self.split_stats["serial"] += 1
-                    return "serial", None
-            # fast events whose id a residue post/void references
-            if pid_set:
-                ref = np.fromiter(
-                    (
-                        (int(a) | (int(b) << 64)) in pid_set
-                        for a, b in zip(arr["id_lo"], arr["id_hi"])
-                    ),
-                    dtype=bool, count=B,
+        # PARTIAL split: fast pv lanes' balance effects hit the PENDING's
+        # accounts — needed for the disjointness fixpoint. Unknown pendings
+        # (not in the registry) move to the residue (the exact scan handles
+        # them); invalid references (0/max -> validation fails with no
+        # balance effect) stay fast.
+        dr = arr["debit_account_id_lo"].astype(np.uint64).copy()
+        cr = arr["credit_account_id_lo"].astype(np.uint64).copy()
+        if pv_live.any():
+            for i in np.nonzero(pv_live)[0]:
+                pid = int(arr["pending_id_lo"][i]) | (
+                    int(arr["pending_id_hi"][i]) << 64
                 )
-                slow |= ref
+                if pid in (0, (1 << 128) - 1):
+                    dr[i] = 0
+                    cr[i] = 0
+                    continue
+                known = self.pending_accounts.get(pid)
+                if known is None:
+                    slow[i] = True
+                else:
+                    dr[i] = known[0] & ((1 << 64) - 1)
+                    cr[i] = known[1] & ((1 << 64) - 1)
+        # residue pvs' pending accounts join the residue account set
+        for i in np.nonzero(pv & slow)[0]:
+            pid = int(arr["pending_id_lo"][i]) | (
+                int(arr["pending_id_hi"][i]) << 64
+            )
+            known = self.pending_accounts.get(pid)
+            if known is not None:
+                extra_acc.append(known[0] & ((1 << 64) - 1))
+                extra_acc.append(known[1] & ((1 << 64) - 1))
+        # residue post/voids referencing FAST ids: those fast events move
+        pid_set = {
+            int(a) | (int(b) << 64)
+            for a, b in zip(
+                arr["pending_id_lo"][pv & slow], arr["pending_id_hi"][pv & slow]
+            )
+        }
+        if pid_set:
+            ref = np.fromiter(
+                (
+                    (int(a) | (int(b) << 64)) in pid_set
+                    for a, b in zip(arr["id_lo"], arr["id_hi"])
+                ),
+                dtype=bool, count=B,
+            )
+            slow |= ref
 
         # account-disjointness fixpoint (lo limbs; collisions conservative)
-        dr = arr["debit_account_id_lo"].astype(np.uint64)
-        cr = arr["credit_account_id_lo"].astype(np.uint64)
         extra = np.array(extra_acc, dtype=np.uint64)
         for _ in range(64):
             if slow.all():
@@ -1172,16 +1338,31 @@ class HazardTracker:
             self.split_stats["serial"] += 1
             return "serial", None
 
+        # (the fixpoint only ever grows `slow`, so at least one slow lane
+        # remains here)
         n_fast = int((~slow).sum())
-        if not slow.any():
-            self.split_stats["fast"] += 1
-            return "fast", None
         if n_fast < max(8, B // 8):
             # too little fast work to pay for two dispatches
             self.split_stats["serial"] += 1
             return "serial", None
-        self.split_stats["split"] += 1
-        return "split", slow
+        name = "split_pv" if (pv & ~slow).any() else "split"
+        self.split_stats[name] = self.split_stats.get(name, 0) + 1
+        return name, slow
+
+    @staticmethod
+    def _dup_groups(h: np.ndarray) -> np.ndarray:
+        """Lanes whose hash value occurs more than once (conservative)."""
+        B = len(h)
+        order = np.argsort(h, kind="stable")
+        hs = h[order]
+        dup_sorted = np.zeros(B, dtype=bool)
+        if B > 1:
+            eq = hs[1:] == hs[:-1]
+            dup_sorted[1:] |= eq
+            dup_sorted[:-1] |= eq
+        dup = np.zeros(B, dtype=bool)
+        dup[order] = dup_sorted
+        return dup
 
     def _touches_limit(self, arr: np.ndarray) -> np.ndarray:
         lo2 = np.stack([arr["debit_account_id_lo"], arr["credit_account_id_lo"]])
@@ -1393,9 +1574,10 @@ class DeviceLedger(HostLedgerBase):
             else:  # forced tier (parity tests); the amount bound is unused
                 decision, slow_mask = self.mode, None
             self.hazards.note_pending(arr)
-            if decision == "split":
+            if decision in ("split", "split_pv"):
                 results = self._execute_split(
-                    arr, n, n_pad, nn, ts, timestamp, slow_mask
+                    arr, n, n_pad, nn, ts, timestamp, slow_mask,
+                    fast_mode="fast_pv" if decision == "split_pv" else "fast",
                 )
             else:
                 batch = transfers_to_batch(arr, n_pad)
@@ -1426,7 +1608,8 @@ class DeviceLedger(HostLedgerBase):
             operation, n, results, flags=arr["flags"].copy()
         )
 
-    def _execute_split(self, arr, n, n_pad, nn, ts, timestamp: int, slow_mask):
+    def _execute_split(self, arr, n, n_pad, nn, ts, timestamp: int, slow_mask,
+                       fast_mode: str = "fast"):
         """The middle tier: the fast-eligible majority runs vectorized with
         the residue lanes masked out, then the hazard residue runs through
         the exact serial scan COMPACTED (cost scales with residue size, not
@@ -1438,7 +1621,7 @@ class DeviceLedger(HostLedgerBase):
         batch = transfers_to_batch(arr, n_pad)
         batch["mask"] = jnp.asarray(mask_np)
         self.state, r_fast = self.kernels.commit_transfers(
-            self.state, batch, nn, ts, mode="fast"
+            self.state, batch, nn, ts, mode=fast_mode
         )
 
         idx = np.nonzero(slow_mask)[0]
