@@ -246,8 +246,10 @@ def bench_tracked_configs(stage) -> dict:
         pend0["flags"] = 2
         # keep pending accounts in a reserved low range, disjoint from the
         # fast majority below
-        pend0["debit_account_id_lo"] = 1 + (np.arange(BATCH) % 500)
-        pend0["credit_account_id_lo"] = 501 + (np.arange(BATCH) % 500)
+        # pending accounts 1..599: disjoint from the chain range (600..900)
+        # AND the fast majority (>1000), so the fixpoint cannot cascade
+        pend0["debit_account_id_lo"] = 1 + (np.arange(BATCH) % 300)
+        pend0["credit_account_id_lo"] = 301 + (np.arange(BATCH) % 299)
         ts += BATCH
         ledger.execute_async(Operation.create_transfers, ts, pend0)
         batches = []

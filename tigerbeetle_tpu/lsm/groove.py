@@ -1,0 +1,126 @@
+"""Grooves and the Forest: the object stores over LSM trees.
+
+The reference's Groove (reference: src/lsm/groove.zig:23-77, 602-1010):
+ObjectTree keyed by timestamp + IdTree mapping id -> timestamp, with
+get/insert/upsert and the prefetch contract (async load, then synchronous
+get during commit). The Forest fans open/flush/checkpoint out to every
+groove (reference: src/lsm/forest.zig:253-407).
+
+Role in the TPU design: the HBM hash tables ARE the working set; this LSM
+forest is the bounded-memory BACKING store once state exceeds HBM — cold
+rows spill here (timestamp-keyed, id-indexed) and prefetch() pulls an id's
+row back before a commit needs it. The spill/reload scheduler itself is
+future work; the storage engine + contracts land here.
+"""
+
+from __future__ import annotations
+
+from tigerbeetle_tpu.lsm.grid import Grid
+from tigerbeetle_tpu.lsm.tree import Tree
+
+ID_SIZE = 16
+TS_SIZE = 8
+OBJECT_SIZE = 128
+
+
+class Groove:
+    def __init__(self, grid: Grid, memtable_max: int = 2048):
+        # ObjectTree: timestamp (big-endian, order-preserving) -> 128B row
+        self.objects = Tree(grid, TS_SIZE, OBJECT_SIZE, memtable_max)
+        # IdTree: id (big-endian u128) -> timestamp (reference IdTreeValue)
+        self.ids = Tree(grid, ID_SIZE, TS_SIZE, memtable_max)
+        # prefetch cache: id -> row (the CacheMap residency contract:
+        # prefetched values stay resident through the commit, reference:
+        # src/lsm/cache_map.zig:10-25)
+        self.prefetched: dict[int, bytes | None] = {}
+
+    @staticmethod
+    def _id_key(id_: int) -> bytes:
+        return id_.to_bytes(ID_SIZE, "big")
+
+    @staticmethod
+    def _ts_key(timestamp: int) -> bytes:
+        return timestamp.to_bytes(TS_SIZE, "big")
+
+    # -- writes (reference: groove.insert/upsert/remove :902-966) --
+
+    def insert(self, id_: int, timestamp: int, row: bytes) -> None:
+        assert len(row) == OBJECT_SIZE
+        self.objects.put(self._ts_key(timestamp), row)
+        self.ids.put(self._id_key(id_), self._ts_key(timestamp))
+
+    def upsert(self, id_: int, timestamp: int, row: bytes) -> None:
+        self.objects.put(self._ts_key(timestamp), row)
+        self.ids.put(self._id_key(id_), self._ts_key(timestamp))
+
+    def remove(self, id_: int, timestamp: int) -> None:
+        self.objects.remove(self._ts_key(timestamp))
+        self.ids.remove(self._id_key(id_))
+
+    # -- reads: prefetch then synchronous get (reference :608-760, 602) --
+
+    def prefetch(self, ids: list[int]) -> None:
+        """Load the working set (IdTree -> ObjectTree cascade). After this,
+        get() is synchronous and pure — the property that lets the commit
+        step run as one device kernel."""
+        for id_ in ids:
+            if id_ in self.prefetched:
+                continue
+            ts_key = self.ids.get(self._id_key(id_))
+            self.prefetched[id_] = (
+                None if ts_key is None else self.objects.get(ts_key)
+            )
+
+    def get(self, id_: int) -> bytes | None:
+        assert id_ in self.prefetched, "get() before prefetch()"
+        return self.prefetched[id_]
+
+    def prefetch_clear(self) -> None:
+        self.prefetched.clear()
+
+    # -- lifecycle --
+
+    def flush(self) -> None:
+        self.objects.flush()
+        self.ids.flush()
+
+    def manifest(self) -> dict:
+        return {"objects": self.objects.manifest(), "ids": self.ids.manifest()}
+
+    def restore_manifest(self, m: dict) -> None:
+        self.objects.restore_manifest(m["objects"])
+        self.ids.restore_manifest(m["ids"])
+
+
+class Forest:
+    """The grooves of the accounting state machine (reference:
+    src/state_machine.zig:67-100: accounts, transfers, posted)."""
+
+    def __init__(self, grid: Grid):
+        self.grid = grid
+        self.accounts = Groove(grid)
+        self.transfers = Groove(grid)
+        # posted: pending timestamp -> fulfillment byte (padded value)
+        self.posted = Tree(grid, TS_SIZE, 1, 2048)
+
+    def flush(self) -> None:
+        self.accounts.flush()
+        self.transfers.flush()
+        self.posted.flush()
+
+    def checkpoint(self) -> dict:
+        """Flush everything and return the durable manifest (persisted in
+        the superblock checkpoint meta alongside the free set)."""
+        self.flush()
+        return {
+            "accounts": self.accounts.manifest(),
+            "transfers": self.transfers.manifest(),
+            "posted": self.posted.manifest(),
+            "free_set": self.grid.encode_free_set().hex(),
+        }
+
+    def restore(self, m: dict) -> None:
+        self.accounts.restore_manifest(m["accounts"])
+        self.transfers.restore_manifest(m["transfers"])
+        self.posted.restore_manifest(m["posted"])
+        self.grid.restore_free_set(bytes.fromhex(m["free_set"]))

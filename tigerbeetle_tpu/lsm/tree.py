@@ -1,0 +1,219 @@
+"""One LSM tree over the Grid (reference: src/lsm/tree.zig, table.zig,
+table_memory.zig, compaction.zig, manifest.zig — collapsed to their
+load-bearing contracts):
+
+- fixed-width keys (big-endian-comparable bytes) and values;
+- a mutable in-memory table absorbs puts/removes; on flush it becomes an
+  immutable ON-DISK table: sorted (key, value) pairs packed into grid data
+  blocks plus one index block of first-keys (binary-searched on lookup);
+- levels 0..n with growth factor 8: lookups cascade memtable -> level 0
+  newest-first -> deeper levels; the first hit wins;
+- compaction merges a level's tables into the next when the level exceeds
+  its budget (k-way merge, newest-wins dedup, tombstone GC at the bottom);
+- the manifest (table metadata: level, key range, block addresses) is a
+  plain structure serialized with the tree's checkpoint (reference keeps a
+  ManifestLog of blocks; here it rides the checkpoint trailer).
+
+Tombstone = value of all 0xFF (valid object values never are: wire rows
+carry nonzero ids in the id field's position).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tigerbeetle_tpu.lsm.grid import BLOCK_PAYLOAD_MAX, Grid
+
+GROWTH_FACTOR = 8  # reference: src/config.zig:142
+LEVEL0_TABLES_MAX = 4
+
+
+@dataclasses.dataclass
+class TableInfo:
+    """Manifest entry (reference: src/lsm/manifest.zig TableInfo)."""
+
+    index_address: int
+    key_min: bytes
+    key_max: bytes
+    entry_count: int
+
+    def to_json(self):
+        return {
+            "index_address": self.index_address,
+            "key_min": self.key_min.hex(),
+            "key_max": self.key_max.hex(),
+            "entry_count": self.entry_count,
+        }
+
+    @staticmethod
+    def from_json(d):
+        return TableInfo(
+            index_address=d["index_address"],
+            key_min=bytes.fromhex(d["key_min"]),
+            key_max=bytes.fromhex(d["key_max"]),
+            entry_count=d["entry_count"],
+        )
+
+
+class Tree:
+    def __init__(self, grid: Grid, key_size: int, value_size: int,
+                 memtable_max: int = 4096):
+        self.grid = grid
+        self.key_size = key_size
+        self.value_size = value_size
+        self.entry_size = key_size + value_size
+        self.entries_per_block = BLOCK_PAYLOAD_MAX // self.entry_size
+        self.memtable_max = memtable_max
+        self.memtable: dict[bytes, bytes] = {}
+        self.tombstone = b"\xff" * value_size
+        # levels[0] is newest-first; deeper levels hold older data
+        self.levels: list[list[TableInfo]] = [[]]
+
+    # -- writes --
+
+    def put(self, key: bytes, value: bytes) -> None:
+        assert len(key) == self.key_size and len(value) == self.value_size
+        assert value != self.tombstone
+        self.memtable[key] = value
+        if len(self.memtable) >= self.memtable_max:
+            self.flush()
+
+    def remove(self, key: bytes) -> None:
+        assert len(key) == self.key_size
+        self.memtable[key] = self.tombstone
+
+    # -- reads (the lookup cascade, reference: src/lsm/tree.zig:303-433) --
+
+    def get(self, key: bytes) -> bytes | None:
+        hit = self.memtable.get(key)
+        if hit is not None:
+            return None if hit == self.tombstone else hit
+        for level in self.levels:
+            for info in level:  # newest-first within a level
+                if info.key_min <= key <= info.key_max:
+                    hit = self._table_get(info, key)
+                    if hit is not None:
+                        return None if hit == self.tombstone else hit
+        return None
+
+    def _table_get(self, info: TableInfo, key: bytes) -> bytes | None:
+        index = self.grid.read_block(info.index_address)
+        # index payload: [addr u64][first_key key_size] per data block
+        rec = 8 + self.key_size
+        n = len(index) // rec
+        lo, hi = 0, n - 1
+        pos = 0
+        while lo <= hi:  # last block whose first key <= key
+            mid = (lo + hi) // 2
+            first = index[mid * rec + 8 : mid * rec + 8 + self.key_size]
+            if first <= key:
+                pos = mid
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        addr = int.from_bytes(index[pos * rec : pos * rec + 8], "little")
+        data = self.grid.read_block(addr)
+        e = self.entry_size
+        lo, hi = 0, len(data) // e - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            k = data[mid * e : mid * e + self.key_size]
+            if k == key:
+                return data[mid * e + self.key_size : (mid + 1) * e]
+            if k < key:
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return None
+
+    # -- flush / compaction --
+
+    def flush(self) -> None:
+        if not self.memtable:
+            return
+        items = sorted(self.memtable.items())
+        self.memtable = {}
+        self.levels[0].insert(0, self._write_table(items))
+        self._maybe_compact()
+
+    def _write_table(self, items: list[tuple[bytes, bytes]]) -> TableInfo:
+        index = bytearray()
+        for i in range(0, len(items), self.entries_per_block):
+            chunk = items[i : i + self.entries_per_block]
+            payload = b"".join(k + v for k, v in chunk)
+            addr = self.grid.create_block(payload)
+            index += addr.to_bytes(8, "little") + chunk[0][0]
+        index_address = self.grid.create_block(bytes(index))
+        return TableInfo(
+            index_address=index_address,
+            key_min=items[0][0], key_max=items[-1][0],
+            entry_count=len(items),
+        )
+
+    def _level_budget(self, level: int) -> int:
+        return LEVEL0_TABLES_MAX * (GROWTH_FACTOR ** level)
+
+    def _maybe_compact(self) -> None:
+        for level in range(len(self.levels)):
+            if len(self.levels[level]) > self._level_budget(level):
+                self._compact_level(level)
+
+    def _compact_level(self, level: int) -> None:
+        """Merge ALL of `level` into `level+1` (the reference paces one
+        table per half-bar; whole-level merges trade pacing for simplicity
+        while preserving the shape: newer level wins, bottom level drops
+        tombstones — reference: src/lsm/compaction.zig:1-32)."""
+        if level + 1 >= len(self.levels):
+            self.levels.append([])
+        merged: dict[bytes, bytes] = {}
+        # strictly oldest-first so newer entries overwrite: the DEEPER
+        # level's tables (older data) first, each level oldest-to-newest
+        # (lists are newest-first)
+        for info in (
+            list(reversed(self.levels[level + 1]))
+            + list(reversed(self.levels[level]))
+        ):
+            merged.update(self._read_table(info))
+            self.grid_release_table(info)
+        bottom = level + 1 == len(self.levels) - 1
+        items = sorted(
+            (k, v)
+            for k, v in merged.items()
+            if not (bottom and v == self.tombstone)  # tombstone GC
+        )
+        self.levels[level] = []
+        self.levels[level + 1] = [self._write_table(items)] if items else []
+
+    def _read_table(self, info: TableInfo) -> dict[bytes, bytes]:
+        out: dict[bytes, bytes] = {}
+        index = self.grid.read_block(info.index_address)
+        rec = 8 + self.key_size
+        e = self.entry_size
+        for i in range(len(index) // rec):
+            addr = int.from_bytes(index[i * rec : i * rec + 8], "little")
+            data = self.grid.read_block(addr)
+            for j in range(len(data) // e):
+                out[data[j * e : j * e + self.key_size]] = \
+                    data[j * e + self.key_size : (j + 1) * e]
+        return out
+
+    def grid_release_table(self, info: TableInfo) -> None:
+        index = self.grid.read_block(info.index_address)
+        rec = 8 + self.key_size
+        for i in range(len(index) // rec):
+            self.grid.release(int.from_bytes(index[i * rec : i * rec + 8], "little"))
+        self.grid.release(info.index_address)
+
+    # -- checkpoint --
+
+    def manifest(self) -> list:
+        """The durable table metadata (flush() first for completeness)."""
+        return [
+            [info.to_json() for info in level] for level in self.levels
+        ]
+
+    def restore_manifest(self, manifest: list) -> None:
+        self.levels = [
+            [TableInfo.from_json(d) for d in level] for level in manifest
+        ]
+        self.memtable = {}

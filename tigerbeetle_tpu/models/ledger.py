@@ -309,8 +309,6 @@ def _fold_digits_signed(row32, acc32):
     host-proven batches: every subtraction is a distinct committed pending's
     amount already included in the balance; kept as the device backstop).
     Returns (new_row, bad)."""
-    import jax
-
     new_words = [row32[..., i] for i in range(ROW_WORDS)]
     bad = jnp.zeros(row32.shape[:-1], dtype=bool)
     I64 = jnp.int64
@@ -349,6 +347,38 @@ def _combined_overflow(new_rows_t):
     _, _, c_dr = u128.add(nr["dp_lo"], nr["dp_hi"], nr["dpo_lo"], nr["dpo_hi"])
     _, _, c_cr = u128.add(nr["cp_lo"], nr["cp_hi"], nr["cpo_lo"], nr["cpo_hi"])
     return c_dr | c_cr
+
+
+def build_stored_transfer(e, p, is_pv, amt_lo, amt_hi, ts) -> dict:
+    """The row a create_transfers event STORES: post/void events inherit the
+    pending's routing fields, default their user data from it, and persist
+    the resolved amount (reference: src/state_machine.zig:907-1014). Shared
+    by the fast_pv kernel (batched) and the serial scan (per event) so the
+    two tiers cannot drift."""
+
+    def dflt128(t_lo, t_hi, q_lo, q_hi):
+        z = u128.is_zero(t_lo, t_hi)
+        return jnp.where(z, q_lo, t_lo), jnp.where(z, q_hi, t_hi)
+
+    t2_ud128 = dflt128(e["ud128_lo"], e["ud128_hi"], p["ud128_lo"], p["ud128_hi"])
+    return {
+        "id_lo": e["id_lo"], "id_hi": e["id_hi"],
+        "dr_lo": jnp.where(is_pv, p["dr_lo"], e["dr_lo"]),
+        "dr_hi": jnp.where(is_pv, p["dr_hi"], e["dr_hi"]),
+        "cr_lo": jnp.where(is_pv, p["cr_lo"], e["cr_lo"]),
+        "cr_hi": jnp.where(is_pv, p["cr_hi"], e["cr_hi"]),
+        "amt_lo": amt_lo, "amt_hi": amt_hi,
+        "pid_lo": e["pid_lo"], "pid_hi": e["pid_hi"],
+        "ud128_lo": jnp.where(is_pv, t2_ud128[0], e["ud128_lo"]),
+        "ud128_hi": jnp.where(is_pv, t2_ud128[1], e["ud128_hi"]),
+        "ud64": jnp.where(is_pv & (e["ud64"] == 0), p["ud64"], e["ud64"]),
+        "ud32": jnp.where(is_pv & (e["ud32"] == 0), p["ud32"], e["ud32"]),
+        "timeout": jnp.where(is_pv, jnp.uint32(0), e["timeout"]),
+        "ledger": jnp.where(is_pv, p["ledger"], e["ledger"]),
+        "code": jnp.where(is_pv, p["code"], e["code"]),
+        "flags": e["flags"],
+        "ts": ts,
+    }
 
 
 def _set_ts_words(rows, ts):
@@ -555,35 +585,9 @@ class LedgerKernels:
 
         # --- application (every write gated on `proceed`) ---
         if pv_mode:
-            # stored post/void rows inherit the pending's routing fields
-            # (reference: src/state_machine.zig:907-1014); vectorized form
-            # of the serial tier's row construction
-            def dflt128(t_lo, t_hi, q_lo, q_hi):
-                z = u128.is_zero(t_lo, t_hi)
-                return jnp.where(z, q_lo, t_lo), jnp.where(z, q_hi, t_hi)
-
-            t2_ud128 = dflt128(
-                e["ud128_lo"], e["ud128_hi"], p["ud128_lo"], p["ud128_hi"]
+            ins_rows = pack_transfer(
+                build_stored_transfer(e, p, is_pv, amt_lo, amt_hi, ts_vec)
             )
-            ins = {
-                "id_lo": e["id_lo"], "id_hi": e["id_hi"],
-                "dr_lo": jnp.where(is_pv, p["dr_lo"], e["dr_lo"]),
-                "dr_hi": jnp.where(is_pv, p["dr_hi"], e["dr_hi"]),
-                "cr_lo": jnp.where(is_pv, p["cr_lo"], e["cr_lo"]),
-                "cr_hi": jnp.where(is_pv, p["cr_hi"], e["cr_hi"]),
-                "amt_lo": amt_lo, "amt_hi": amt_hi,
-                "pid_lo": e["pid_lo"], "pid_hi": e["pid_hi"],
-                "ud128_lo": jnp.where(is_pv, t2_ud128[0], e["ud128_lo"]),
-                "ud128_hi": jnp.where(is_pv, t2_ud128[1], e["ud128_hi"]),
-                "ud64": jnp.where(is_pv & (e["ud64"] == 0), p["ud64"], e["ud64"]),
-                "ud32": jnp.where(is_pv & (e["ud32"] == 0), p["ud32"], e["ud32"]),
-                "timeout": jnp.where(is_pv, jnp.uint32(0), e["timeout"]),
-                "ledger": jnp.where(is_pv, p["ledger"], e["ledger"]),
-                "code": jnp.where(is_pv, p["code"], e["code"]),
-                "flags": e["flags"],
-                "ts": ts_vec,
-            }
-            ins_rows = pack_transfer(ins)
         else:
             ins_rows = _set_ts_words(rows_b, ts_vec)
         acct2 = acct_rows.at[jnp.where(proceed, slots_t, self.a_dump)].set(new_rows_t)
@@ -738,31 +742,10 @@ class LedgerKernels:
             is_post = is_pv & ((e["flags"] & jnp.uint32(F_POST)) != 0)
             is_pending = ~is_pv & ((e["flags"] & jnp.uint32(F_PENDING)) != 0)
 
-            # --- build the row to insert ---
-            def dflt128(t_lo, t_hi, p_lo, p_hi):
-                z = u128.is_zero(t_lo, t_hi)
-                return jnp.where(z, p_lo, t_lo), jnp.where(z, p_hi, t_hi)
-
-            t2_ud128 = dflt128(e["ud128_lo"], e["ud128_hi"], p["ud128_lo"], p["ud128_hi"])
-            ins = {
-                "id_lo": e["id_lo"], "id_hi": e["id_hi"],
-                "dr_lo": jnp.where(is_pv, p["dr_lo"], e["dr_lo"]),
-                "dr_hi": jnp.where(is_pv, p["dr_hi"], e["dr_hi"]),
-                "cr_lo": jnp.where(is_pv, p["cr_lo"], e["cr_lo"]),
-                "cr_hi": jnp.where(is_pv, p["cr_hi"], e["cr_hi"]),
-                "amt_lo": amt_lo, "amt_hi": amt_hi,
-                "pid_lo": e["pid_lo"], "pid_hi": e["pid_hi"],
-                "ud128_lo": jnp.where(is_pv, t2_ud128[0], e["ud128_lo"]),
-                "ud128_hi": jnp.where(is_pv, t2_ud128[1], e["ud128_hi"]),
-                "ud64": jnp.where(is_pv & (e["ud64"] == 0), p["ud64"], e["ud64"]),
-                "ud32": jnp.where(is_pv & (e["ud32"] == 0), p["ud32"], e["ud32"]),
-                "timeout": jnp.where(is_pv, jnp.uint32(0), e["timeout"]),
-                "ledger": jnp.where(is_pv, p["ledger"], e["ledger"]),
-                "code": jnp.where(is_pv, p["code"], e["code"]),
-                "flags": e["flags"],
-                "ts": ts,
-            }
-            ins_row = pack_transfer(ins)
+            # --- build the row to insert (shared with the fast_pv tier) ---
+            ins_row = pack_transfer(
+                build_stored_transfer(e, p, is_pv, amt_lo, amt_hi, ts)
+            )
             free_slot, free_ok = ht.probe_free(row_e[:4], xfer_rows, self.t_log2)
             probe_bad = probe_bad | (ok & ~free_ok)
             w = jnp.where(ok & free_ok, free_slot, t_dump)

@@ -75,6 +75,7 @@ from tigerbeetle_tpu.models.ledger import (
     HazardTracker,
     HostLedgerBase,
     accounts_to_batch,
+    build_stored_transfer,
     key4_from_fields,
     pack_account,
     pack_transfer,
@@ -544,31 +545,10 @@ class ShardedLedgerKernels:
             is_post = is_pv & ((e["flags"] & jnp.uint32(F_POST)) != 0)
             is_pending = ~is_pv & ((e["flags"] & jnp.uint32(F_PENDING)) != 0)
 
-            # --- build the row to insert (replicated) ---
-            def dflt128(t_lo, t_hi, p_lo, p_hi):
-                z = u128.is_zero(t_lo, t_hi)
-                return jnp.where(z, p_lo, t_lo), jnp.where(z, p_hi, t_hi)
-
-            t2_ud128 = dflt128(e["ud128_lo"], e["ud128_hi"], p["ud128_lo"], p["ud128_hi"])
-            ins = {
-                "id_lo": e["id_lo"], "id_hi": e["id_hi"],
-                "dr_lo": jnp.where(is_pv, p["dr_lo"], e["dr_lo"]),
-                "dr_hi": jnp.where(is_pv, p["dr_hi"], e["dr_hi"]),
-                "cr_lo": jnp.where(is_pv, p["cr_lo"], e["cr_lo"]),
-                "cr_hi": jnp.where(is_pv, p["cr_hi"], e["cr_hi"]),
-                "amt_lo": amt_lo, "amt_hi": amt_hi,
-                "pid_lo": e["pid_lo"], "pid_hi": e["pid_hi"],
-                "ud128_lo": jnp.where(is_pv, t2_ud128[0], e["ud128_lo"]),
-                "ud128_hi": jnp.where(is_pv, t2_ud128[1], e["ud128_hi"]),
-                "ud64": jnp.where(is_pv & (e["ud64"] == 0), p["ud64"], e["ud64"]),
-                "ud32": jnp.where(is_pv & (e["ud32"] == 0), p["ud32"], e["ud32"]),
-                "timeout": jnp.where(is_pv, jnp.uint32(0), e["timeout"]),
-                "ledger": jnp.where(is_pv, p["ledger"], e["ledger"]),
-                "code": jnp.where(is_pv, p["code"], e["code"]),
-                "flags": e["flags"],
-                "ts": ts,
-            }
-            ins_row = pack_transfer(ins)
+            # --- build the row to insert (replicated; shared helper) ---
+            ins_row = pack_transfer(
+                build_stored_transfer(e, p, is_pv, amt_lo, amt_hi, ts)
+            )
             # Insert on the id's owner shard only.
             id_own = owner_of_key4(row_e[:4], self.n_shards) == my
             free_slot, free_ok = ht.probe_free(row_e[:4], xfer_rows, self.t_log2)
