@@ -1,0 +1,110 @@
+/* Example C program against a running cluster — demonstrates the tb_client
+ * ABI without any Python (compile: gcc example_client.c -L. -ltb_native).
+ *
+ * Creates two accounts, moves 100 units, and prints the balances.
+ * Usage: ./example_client host:port[,host:port...]
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+#include "tb_client.h"
+
+/* 128-byte wire layouts (tigerbeetle_tpu/types.py) */
+#pragma pack(push, 1)
+typedef struct {
+  uint64_t id_lo, id_hi;
+  uint64_t debits_pending_lo, debits_pending_hi;
+  uint64_t debits_posted_lo, debits_posted_hi;
+  uint64_t credits_pending_lo, credits_pending_hi;
+  uint64_t credits_posted_lo, credits_posted_hi;
+  uint64_t user_data_128_lo, user_data_128_hi;
+  uint64_t user_data_64;
+  uint32_t user_data_32, reserved, ledger;
+  uint16_t code, flags;
+  uint64_t timestamp;
+} tb_account_t;
+
+typedef struct {
+  uint64_t id_lo, id_hi;
+  uint64_t debit_account_id_lo, debit_account_id_hi;
+  uint64_t credit_account_id_lo, credit_account_id_hi;
+  uint64_t amount_lo, amount_hi;
+  uint64_t pending_id_lo, pending_id_hi;
+  uint64_t user_data_128_lo, user_data_128_hi;
+  uint64_t user_data_64;
+  uint32_t user_data_32, timeout, ledger;
+  uint16_t code, flags;
+  uint64_t timestamp;
+} tb_transfer_t;
+
+typedef struct {
+  uint32_t index, result;
+} tb_result_t;
+#pragma pack(pop)
+
+enum {
+  OP_CREATE_ACCOUNTS = 128,
+  OP_CREATE_TRANSFERS = 129,
+  OP_LOOKUP_ACCOUNTS = 130,
+};
+
+int main(int argc, char **argv) {
+  const char *addresses = argc > 1 ? argv[1] : "127.0.0.1:3001";
+  uint8_t client_id[16] = {7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 1};
+
+  tb_client_t *client;
+  int rc = tb_client_init(&client, addresses, 0, 0, client_id);
+  if (rc != 0) {
+    fprintf(stderr, "init failed: %d\n", rc);
+    return 1;
+  }
+
+  tb_account_t accounts[2];
+  memset(accounts, 0, sizeof(accounts));
+  accounts[0].id_lo = 901;
+  accounts[0].ledger = 700;
+  accounts[0].code = 10;
+  accounts[1].id_lo = 902;
+  accounts[1].ledger = 700;
+  accounts[1].code = 10;
+
+  uint8_t reply[8192];
+  uint64_t reply_len = 0;
+  rc = tb_client_request(client, OP_CREATE_ACCOUNTS, accounts,
+                         sizeof(accounts), reply, sizeof(reply), &reply_len);
+  if (rc != 0) return 2;
+  for (uint64_t i = 0; i < reply_len / sizeof(tb_result_t); i++) {
+    tb_result_t *r = (tb_result_t *)(reply + i * sizeof(tb_result_t));
+    printf("account[%u]: result %u\n", r->index, r->result);
+  }
+
+  tb_transfer_t transfer;
+  memset(&transfer, 0, sizeof(transfer));
+  transfer.id_lo = 901;
+  transfer.debit_account_id_lo = 901;
+  transfer.credit_account_id_lo = 902;
+  transfer.amount_lo = 100;
+  transfer.ledger = 700;
+  transfer.code = 10;
+  rc = tb_client_request(client, OP_CREATE_TRANSFERS, &transfer,
+                         sizeof(transfer), reply, sizeof(reply), &reply_len);
+  if (rc != 0) return 3;
+  printf("transfer: %s\n", reply_len == 0 ? "ok" : "failed");
+
+  uint64_t ids[4] = {901, 0, 902, 0}; /* packed LE u128 ids */
+  rc = tb_client_request(client, OP_LOOKUP_ACCOUNTS, ids, sizeof(ids), reply,
+                         sizeof(reply), &reply_len);
+  if (rc != 0) return 4;
+  for (uint64_t i = 0; i < reply_len / sizeof(tb_account_t); i++) {
+    tb_account_t *a = (tb_account_t *)(reply + i * sizeof(tb_account_t));
+    printf("account %llu: debits_posted=%llu credits_posted=%llu\n",
+           (unsigned long long)a->id_lo,
+           (unsigned long long)a->debits_posted_lo,
+           (unsigned long long)a->credits_posted_lo);
+  }
+
+  tb_client_deinit(client);
+  return 0;
+}
