@@ -40,6 +40,21 @@ def test_simulation_heavy_faults():
     assert stats["committed_ops"] > 10
 
 
+def test_simulation_wal_faults_exercised():
+    """Crash-heavy run with guaranteed WAL corruption on restart: the
+    journal's faulty-slot detection + peer repair must carry the run."""
+    stats = run_simulation(
+        9,
+        ticks=900,
+        crash_probability=0.008,
+        restart_ticks_max=40,
+        wal_fault_probability=1.0,
+    )
+    assert stats["crashes"] >= 2
+    assert stats["wal_faults"] >= 1
+    assert stats["committed_ops"] > 20
+
+
 def test_simulation_device_backend():
     """One seed with the REAL device-ledger backend behind every replica
     (slow: jit commits on the CPU mesh) — the TPU kernels under consensus,
