@@ -101,7 +101,6 @@ def test_native_client_end_to_end(server):
 
 
 def test_repl_against_live_server(server):
-    import io
 
     from tigerbeetle_tpu.repl import Repl, parse_statement
     from tigerbeetle_tpu.types import Operation

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import heapq
 import random
-from typing import Callable
 
 from tigerbeetle_tpu.io.network import Address, Handler, Network
 

@@ -39,7 +39,7 @@ from tigerbeetle_tpu.testing.workload import WorkloadGenerator
 from tigerbeetle_tpu.types import Operation
 from tigerbeetle_tpu.vsr.client import Client
 from tigerbeetle_tpu.vsr.durable import format_data_file
-from tigerbeetle_tpu.vsr.header import Command, Header
+from tigerbeetle_tpu.vsr.header import Header
 from tigerbeetle_tpu.vsr.replica import Replica
 
 CLIENT_ID_BASE = 1 << 64

@@ -43,7 +43,7 @@ from tigerbeetle_tpu.io.storage import Storage, Zone
 from tigerbeetle_tpu.models.ledger import DeviceLedger, init_state
 from tigerbeetle_tpu.state_machine import StateMachine
 from tigerbeetle_tpu.types import Operation
-from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
+from tigerbeetle_tpu.vsr.header import Command, Header
 from tigerbeetle_tpu.vsr.journal import Journal
 from tigerbeetle_tpu.vsr.superblock import BlobRef, SuperBlock, VSRState
 

@@ -16,7 +16,7 @@ src/vsr.zig:2003-2035 checkpoint arithmetic).
 
 from __future__ import annotations
 
-import numpy as np
+
 
 from tigerbeetle_tpu.constants import ConfigCluster
 from tigerbeetle_tpu.io.storage import SECTOR_SIZE, Storage, Zone
