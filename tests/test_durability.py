@@ -9,7 +9,6 @@ import os
 import subprocess
 import sys
 
-import numpy as np
 import pytest
 
 from tigerbeetle_tpu import native, types
@@ -278,7 +277,7 @@ def test_durable_ledger_checkpoint_ordering_crash_between():
 
     # simulate: blobs of the NEXT checkpoint (the other ping-pong area)
     # written, superblock not
-    area = (1 - dl.superblock.state.area) * (storage.layout.sizes[Zone.grid] // 2)
+    area = (1 - dl.superblock.state.area) * storage.layout.snapshot_area_size
     storage.write(Zone.grid, area, b"\xAA" * 4096)  # garbage partial blobs
 
     dl2 = DurableLedger(storage, TEST_CLUSTER, TEST_PROCESS)

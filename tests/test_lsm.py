@@ -32,10 +32,14 @@ def test_grid_block_roundtrip_and_checksum():
     storage.fault(Zone.grid, (a - 1) * BLOCK_SIZE, 64)
     with pytest.raises(RuntimeError, match="checksum|corrupt"):
         grid.read_block(a)
-    # release + reuse
+    # release STAGES until the next checkpoint: a durable manifest may
+    # still reference the block (crash-restore safety)
     grid.release(b)
     c = grid.acquire()
-    assert c == b  # lowest free address reused
+    assert c != b  # staged, not yet reusable
+    grid.encode_free_set()  # checkpoint applies staged frees
+    d = grid.acquire()
+    assert d == b  # now the lowest free address again
 
 
 def test_tree_put_get_flush_levels():
@@ -67,9 +71,12 @@ def test_tree_compaction_reclaims_blocks_and_drops_tombstones():
     for i in range(0, 400, 2):
         tree.remove(i.to_bytes(8, "big"))
     tree.flush()
-    # force full compaction to the bottom
-    while sum(len(lv) for lv in tree.levels[:-1]) > 0:
-        tree._compact_level(0)
+    # force full compaction to the bottom, one paced step at a time
+    level = 0
+    while level < len(tree.levels) - 1:
+        while tree.levels[level]:
+            tree._compact_one(level)
+        level += 1
     for i in range(400):
         got = tree.get(i.to_bytes(8, "big"))
         if i % 2 == 0:
@@ -78,8 +85,12 @@ def test_tree_compaction_reclaims_blocks_and_drops_tombstones():
             assert got == (i * 7).to_bytes(8, "big")
     # bottom level carries no tombstones: entry count == live keys
     assert sum(info.entry_count for info in tree.levels[-1]) == 200
+    # superseded tables' blocks stage until a checkpoint applies them
+    staged = len(grid._staged_free)
+    assert staged > 0
     free_before = grid.free_set.count_free()
-    assert free_before > 0  # compaction released superseded tables' blocks
+    grid.encode_free_set()  # checkpoint: staged frees become reusable
+    assert grid.free_set.count_free() == free_before + staged
 
 
 def test_groove_prefetch_contract():
@@ -133,3 +144,41 @@ def test_forest_checkpoint_restore_over_storage():
     forest2.accounts.prefetch_clear()
     forest2.accounts.prefetch([5])
     assert forest2.accounts.get(5) == bytes([6]) * 128  # intact
+
+
+def test_manifest_log_incremental_and_compaction():
+    """Checkpoints persist only NEW TableInfo churn as appended chain
+    blocks; when churn dwarfs the live set the chain compacts to a snapshot
+    and the old blocks release (reference: src/lsm/manifest_log.zig)."""
+    storage, grid = _grid()
+    forest = Forest(grid)
+    model = {}
+    meta = None
+    for round_ in range(6):
+        for i in range(400):
+            k = (round_ * 400 + i) * 31 % 3000 + 1
+            row = bytes([k % 251]) * 128
+            forest.transfers.insert(id_=k, timestamp=round_ * 400 + i + 1,
+                                    row=row)
+            model[k] = (round_ * 400 + i + 1, row)
+        meta = forest.checkpoint()
+    assert meta["manifest_log"]["blocks"], "chain must exist"
+    live = sum(len(t) for tree in forest._trees() for t in tree.levels)
+    assert meta["manifest_log"]["events"] <= max(64, 8 * live), \
+        "chain never compacted"
+    # restore into a fresh forest over the same storage
+    forest2 = Forest(Grid(storage, offset=0, block_count=640,
+                          cache_blocks=64))
+    forest2.restore(meta)
+    for k, (ts, row) in list(model.items())[::37]:
+        g = forest2.transfers
+        ts_key = g.ids.get(g._id_key(k))
+        assert ts_key is not None, k
+        assert g.objects.get(ts_key) == row, k
+    # the levels metadata must round-trip exactly
+    for t1, t2 in zip(forest._trees(), forest2._trees()):
+        assert [
+            [i.to_json() for i in lv] for lv in t1.levels if lv
+        ] == [
+            [i.to_json() for i in lv] for lv in t2.levels if lv
+        ], t1.tree_id

@@ -1,0 +1,179 @@
+"""Secondary index trees + equality queries (reference: per-field index
+trees, src/lsm/groove.zig:137-157 / src/state_machine.zig:103-206 tree ids
+1-24; range scans src/lsm/tree.zig:1126-1140).
+
+Two layers under test:
+- LSM: Groove index maintenance (insert/upsert-diff/remove, composite
+  keys) and Tree.range across flush/compaction, vs a dict model.
+- Device: DeviceLedger.query_accounts/query_transfers — vectorized filter
+  scan over HBM merged with the LSM index over the spilled tail — vs the
+  oracle's full store.
+"""
+
+import random
+
+import pytest
+
+from tests.test_spill import _forest, run_spill_parity
+from tigerbeetle_tpu.constants import TEST_PROCESS
+from tigerbeetle_tpu.lsm.groove import TRANSFER_INDEX_FIELDS, Groove
+from tigerbeetle_tpu.lsm.tree import Tree
+from tigerbeetle_tpu.models.ledger import DeviceLedger
+from tigerbeetle_tpu.models.oracle import OracleStateMachine
+from tigerbeetle_tpu.testing.workload import WorkloadGenerator
+
+
+def _mkrow(rng, ledger, code, dr, cr, amount, ts):
+    """A 128-byte wire row with the given indexed fields."""
+    row = bytearray(rng.randbytes(16))  # id
+    row += dr.to_bytes(16, "little")
+    row += cr.to_bytes(16, "little")
+    row += amount.to_bytes(16, "little")
+    row += rng.randbytes(16)  # pending_id
+    row += rng.randbytes(16)  # user_data_128
+    row += rng.randbytes(8) + rng.randbytes(4)  # ud64, ud32
+    row += (0).to_bytes(4, "little")  # timeout
+    row += ledger.to_bytes(4, "little")
+    row += code.to_bytes(2, "little") + (0).to_bytes(2, "little")
+    row += ts.to_bytes(8, "little")
+    assert len(row) == 128
+    return bytes(row)
+
+
+def test_tree_range_scan():
+    _, forest = _forest()
+    tree = Tree(forest.grid, key_size=8, value_size=8, memtable_max=32)
+    model = {}
+    rng = random.Random(7)
+    for i in range(600):
+        k = rng.randrange(2000).to_bytes(8, "big")
+        v = rng.getrandbits(60).to_bytes(8, "big")
+        tree.put(k, v)
+        model[k] = v
+        if i % 9 == 5:
+            tree.remove(k)
+            model.pop(k)
+    for lo_i, hi_i in [(0, 1999), (100, 300), (1500, 1501), (50, 50), (1990, 3000)]:
+        lo = lo_i.to_bytes(8, "big")
+        hi = min(hi_i, (1 << 63)).to_bytes(8, "big")
+        expect = sorted((k, v) for k, v in model.items() if lo <= k <= hi)
+        assert tree.range(lo, hi) == expect, (lo_i, hi_i)
+
+
+def test_groove_index_maintenance():
+    """insert/upsert-diff/remove keep every index tree consistent with a
+    dict model, across memtable flushes and compactions."""
+    _, forest = _forest()
+    g = Groove(forest.grid, memtable_max=64,
+               index_fields=TRANSFER_INDEX_FIELDS)
+    rng = random.Random(11)
+    rows: dict[int, tuple[int, bytes]] = {}  # id -> (ts, row)
+    next_ts = 1
+    for step in range(500):
+        action = rng.random()
+        if action < 0.6 or not rows:
+            id_ = rng.getrandbits(64) | 1
+            ts = next_ts
+            next_ts += 1
+            row = _mkrow(rng, ledger=rng.randint(1, 3), code=rng.randint(1, 5),
+                         dr=rng.randint(1, 8), cr=rng.randint(1, 8),
+                         amount=rng.randint(1, 6), ts=ts)
+            g.insert(id_, ts, row)
+            rows[id_] = (ts, row)
+        elif action < 0.85:
+            id_ = rng.choice(list(rows))
+            ts, old = rows[id_]
+            new = _mkrow(rng, ledger=rng.randint(1, 3), code=rng.randint(1, 5),
+                         dr=rng.randint(1, 8), cr=rng.randint(1, 8),
+                         amount=rng.randint(1, 6), ts=ts)
+            new = old[:16] + new[16:]  # keep id bytes
+            g.upsert(id_, ts, new, old_row=old)
+            rows[id_] = (ts, new)
+        else:
+            id_ = rng.choice(list(rows))
+            ts, old = rows[id_]
+            g.remove(id_, ts, row=old)
+            del rows[id_]
+    g.flush()
+    for field, lo_v, hi_v in (("ledger", 1, 3), ("code", 1, 5),
+                              ("amount", 1, 6), ("debit_account_id", 1, 8)):
+        off, w = g.index_spec[field]
+        for v in range(lo_v, hi_v + 1):
+            expect = sorted(
+                ts for ts, row in rows.values()
+                if int.from_bytes(row[off : off + w], "little") == v
+            )
+            assert g.query(field, v) == expect, (field, v)
+
+
+def _oracle_query(oracle, store: str, field: str, value: int):
+    objs = (oracle.accounts if store == "acct" else oracle.transfers).values()
+    return sorted(
+        (o for o in objs if getattr(o, field) == value),
+        key=lambda o: o.timestamp,
+    )
+
+
+def test_device_query_parity_no_spill():
+    """Filter-scan queries over a resident-only ledger vs the oracle."""
+    oracle = OracleStateMachine()
+    dev = DeviceLedger(process=TEST_PROCESS, mode="auto")
+    gen = WorkloadGenerator(21, ledgers=(1, 2, 3), invalid_rate=0.05)
+    ts = 1_000_000_000
+    for b in range(8):
+        op, events = (
+            gen.gen_accounts_batch(40) if b % 3 == 0
+            else gen.gen_transfers_batch(40)
+        )
+        ts += len(events)
+        assert oracle.execute_dense(op, ts, events) == dev.execute_dense(
+            op, ts, events
+        )
+    for field in ("ledger", "code"):
+        for v in (1, 2, 3, 77):
+            assert dev.query_accounts(field, v) == _oracle_query(
+                oracle, "acct", field, v
+            ), (field, v)
+    some_acct = next(iter(oracle.accounts))
+    for field, v in (
+        ("ledger", 1), ("ledger", 2), ("code", 50),
+        ("debit_account_id", some_acct), ("credit_account_id", some_acct),
+        ("amount", 1), ("timeout", 0), ("pending_id", 0),
+    ):
+        assert dev.query_transfers(field, v) == _oracle_query(
+            oracle, "xfer", field, v
+        ), (field, v)
+
+
+def test_device_query_parity_with_spill():
+    """Queries must see spilled rows via the LSM index trees and resident
+    rows via the device scan, deduped where stale LSM copies exist."""
+    oracle, dev, _ = run_spill_parity(22, n_transfer_batches=52)
+    assert dev.spill.stats["cycles"] >= 1
+    some_acct = next(iter(oracle.accounts))
+    checks = [
+        ("ledger", 1),
+        ("code", 7), ("code", 50),
+        ("debit_account_id", some_acct), ("credit_account_id", some_acct),
+        ("amount", 1), ("user_data_32", 0),
+    ]
+    for field, v in checks:
+        got = dev.query_transfers(field, v)
+        want = _oracle_query(oracle, "xfer", field, v)
+        assert got == want, (field, v, len(got), len(want))
+    # at least one checked query must have included a spilled row
+    spilled_hit = any(
+        any(t.id in dev.spill.spilled for t in _oracle_query(oracle, "xfer", f, v))
+        for f, v in checks
+    )
+    assert spilled_hit
+
+
+def test_query_value_range_checks():
+    dev = DeviceLedger(process=TEST_PROCESS)
+    with pytest.raises(ValueError):
+        dev.query_transfers("code", 1 << 16)
+    with pytest.raises(ValueError):
+        dev.query_accounts("ledger", 1 << 32)
+    with pytest.raises(KeyError):
+        dev.query_transfers("flags", 1)  # not indexed (reference: ignored)

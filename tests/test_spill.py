@@ -1,0 +1,225 @@
+"""HBM↔LSM spill scheduler: bounded-memory parity (models/spill.py).
+
+TEST_PROCESS's transfer table limit is 2^12 / 2 = 2048 rows; these workloads
+submit several times that, forcing repeated spill cycles, while the
+workload's conflict/two-phase knobs keep referencing long-spilled ids — the
+reload (prefetch) path. Every batch's result codes and the merged
+extract()/lookup surfaces must stay bit-exact against the oracle, which
+never evicts anything (reference contract: src/lsm/groove.zig:602-760 —
+the store is logically unbounded; residency is an implementation detail).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.constants import TEST_CLUSTER, TEST_PROCESS
+from tigerbeetle_tpu.io.storage import MemoryStorage, ZoneLayout
+from tigerbeetle_tpu.lsm.grid import Grid
+from tigerbeetle_tpu.lsm.groove import Forest
+from tigerbeetle_tpu.models.ledger import DeviceLedger
+from tigerbeetle_tpu.models.oracle import OracleStateMachine
+from tigerbeetle_tpu.models.spill import SpillManager
+from tigerbeetle_tpu.testing.workload import WorkloadGenerator
+
+LAYOUT = ZoneLayout(TEST_CLUSTER, grid_size=96 * 1024 * 1024)
+
+
+def _forest(storage=None):
+    storage = storage or MemoryStorage(LAYOUT)
+    return storage, Forest(
+        Grid(storage, offset=0, block_count=640, cache_blocks=64)
+    )
+
+
+def run_spill_parity(seed, n_transfer_batches=60, batch_size=72,
+                     state_every=10, **wl_kwargs):
+    oracle = OracleStateMachine()
+    storage, forest = _forest()
+    dev = DeviceLedger(process=TEST_PROCESS, mode="auto", forest=forest)
+    # High apply-rate knobs (single ledger, few invalids) so the store
+    # actually FILLS past the 2048-row limit; the residual conflict and
+    # two-phase rates keep referencing long-spilled ids (the reload path).
+    knobs = dict(
+        ledgers=(1,),
+        invalid_rate=0.03,
+        conflict_rate=0.06,
+        chain_rate=0.02,
+        two_phase_rate=0.15,
+        balancing_rate=0.05,
+        limit_account_rate=0.05,
+    )
+    knobs.update(wl_kwargs)
+    gen = WorkloadGenerator(seed, **knobs)
+    ts = 1_000_000_000
+
+    def run_batch(op, events, b):
+        nonlocal ts
+        ts += len(events)
+        dense_o = oracle.execute_dense(op, ts, events)
+        dense_d = dev.execute_dense(op, ts, events)
+        if dense_d != dense_o:
+            diffs = [
+                (i, o, d)
+                for i, (o, d) in enumerate(zip(dense_o, dense_d))
+                if o != d
+            ]
+            raise AssertionError(
+                f"batch {b} ({op.name}): (idx, oracle, dev) {diffs[:10]}"
+            )
+
+    # A bounded account population (the account table does not spill) with
+    # an unbounded transfer history — the reference benchmark's shape.
+    for b in range(4):
+        op, events = gen.gen_accounts_batch(40)
+        run_batch(op, events, b)
+    for b in range(n_transfer_batches):
+        op, events = gen.gen_transfers_batch(batch_size)
+        run_batch(op, events, 4 + b)
+        if b % state_every == state_every - 1:
+            accounts, transfers, posted = dev.extract()
+            assert accounts == oracle.accounts, f"batch {b}: accounts diverged"
+            assert transfers == oracle.transfers, f"batch {b}: transfers diverged"
+            assert posted == oracle.posted, f"batch {b}: posted diverged"
+    return oracle, dev, storage
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_spill_parity(seed):
+    oracle, dev, _ = run_spill_parity(seed)
+    assert dev.spill.stats["cycles"] >= 1, "workload never spilled"
+    assert dev.spill.stats["reloaded"] >= 1, "workload never reloaded"
+    assert len(dev.spill.spilled) > 0
+    # final full-state parity (HBM + LSM merged)
+    accounts, transfers, posted = dev.extract()
+    assert accounts == oracle.accounts
+    assert transfers == oracle.transfers
+    assert posted == oracle.posted
+
+
+def test_spill_lookup_parity():
+    """Lookups must see spilled rows (LSM fallback) and HBM rows alike."""
+    oracle, dev, _ = run_spill_parity(13, n_transfer_batches=52)
+    assert dev.spill.stats["cycles"] >= 1
+    ids = sorted(oracle.transfers.keys())
+    rng = np.random.default_rng(0)
+    sample = [ids[i] for i in rng.choice(len(ids), size=60, replace=False)]
+    sample += [9999999999]  # a miss
+    assert dev.lookup_transfers(sample) == oracle.lookup_transfers(sample)
+    # some of the sample must actually have come from the LSM store
+    assert any(i in dev.spill.spilled for i in sample)
+
+
+def test_spill_store_restore():
+    """checkpoint_meta/restore round-trips the LSM manifest + spilled-id set
+    through a fresh Grid/Forest over the same storage (the restart path the
+    superblock checkpoint hook uses)."""
+    oracle, dev, storage = run_spill_parity(14, n_transfer_batches=52)
+    meta = dev.spill.checkpoint_meta()
+    _, forest2 = _forest(storage)
+    sm2 = SpillManager(dev, forest2)
+    sm2.restore(meta)
+    dev.spill = sm2
+    accounts, transfers, posted = dev.extract()
+    assert transfers == oracle.transfers
+    assert posted == oracle.posted
+
+
+def test_spill_checkpoint_survives_later_churn():
+    """A checkpointed manifest must stay readable after LATER spill cycles
+    compact and release blocks: releases stage until the next checkpoint
+    (crash-restore to the old checkpoint must find its blocks intact)."""
+    oracle, dev, storage = run_spill_parity(17, n_transfer_batches=30)
+    meta = dev.spill.checkpoint_meta()
+    want = {
+        id_: dev.spill._fetch(id_) for id_ in sorted(dev.spill.spilled)
+    }
+    # keep running: more cycles, flushes, compactions (block churn)
+    gen = WorkloadGenerator(18, ledgers=(1,), invalid_rate=0.0,
+                            conflict_rate=0.0, chain_rate=0.0,
+                            two_phase_rate=0.0, balancing_rate=0.0)
+    gen.next_id = 1_000_000  # disjoint id space from the first generator
+    gen.account_ids = list(oracle.accounts.keys())[:20]
+    ts = 3_000_000_000
+    for b in range(45):
+        op, events = gen.gen_transfers_batch(72)
+        ts += len(events)
+        dev.execute_dense(op, ts, events)
+    assert dev.spill.stats["cycles"] >= 2
+    # restore the OLD checkpoint into a fresh forest over the same storage:
+    # every spilled row it recorded must still read back bit-exact
+    _, forest2 = _forest(storage)
+    sm2 = SpillManager(dev, forest2)
+    sm2.restore(meta)
+    for id_, (row, ful) in want.items():
+        got = sm2._fetch(id_)
+        assert got == (row, ful), id_
+
+
+def test_spill_durable_restart():
+    """The full durable path: DurableLedger with a forest block area in the
+    layout — WAL + superblock checkpoints carry the spill meta; a restart
+    replays to bit-exact state including the spilled tail."""
+    from tigerbeetle_tpu.vsr.durable import DurableLedger, format_data_file
+
+    layout = ZoneLayout(TEST_CLUSTER, grid_size=96 * 1024 * 1024,
+                        forest_blocks=448)
+    storage = MemoryStorage(layout)
+    format_data_file(storage, TEST_CLUSTER)
+    d1 = DurableLedger(storage, TEST_CLUSTER, TEST_PROCESS)
+    d1.open()
+    assert d1.forest is not None and d1.ledger.spill is not None
+
+    oracle = OracleStateMachine()
+    gen = WorkloadGenerator(19, ledgers=(1,), invalid_rate=0.03,
+                            conflict_rate=0.06, chain_rate=0.02,
+                            two_phase_rate=0.15, balancing_rate=0.05,
+                            limit_account_rate=0.05)
+    import tigerbeetle_tpu.types as types
+    from tigerbeetle_tpu.types import Operation
+
+    def submit(op, events):
+        to_np = (types.accounts_to_np if op == Operation.create_accounts
+                 else types.transfers_to_np)
+        body = to_np(events).tobytes()
+        d1.submit(op, body)
+        oracle.prepare(op, len(events))
+        oracle.execute_dense(op, d1.sm.prepare_timestamp, events)
+
+    for _ in range(3):
+        op, events = gen.gen_accounts_batch(40)
+        submit(op, events)
+    for b in range(62):
+        op, events = gen.gen_transfers_batch(72)
+        submit(op, events)
+    assert d1.ledger.spill.stats["cycles"] >= 1
+    assert d1.checkpoint_op > 0, "no checkpoint happened (WAL wrap expected)"
+
+    # crash: new process over the same storage
+    d2 = DurableLedger(storage, TEST_CLUSTER, TEST_PROCESS)
+    d2.open()
+    a2, t2, p2 = d2.ledger.extract()
+    assert a2 == oracle.accounts
+    assert t2 == oracle.transfers
+    assert p2 == oracle.posted
+
+
+def test_forced_serial_spill_parity():
+    """The exact serial tier must also see reloaded rows (its probes hit the
+    same HBM tables)."""
+    oracle, dev, _ = run_spill_parity(
+        15, n_transfer_batches=52, batch_size=72, state_every=8
+    )
+    # exercised implicitly by hazard routing; force a final serial batch
+    # that references old (spilled) ids via duplicates
+    gen = WorkloadGenerator(16)
+    gen.account_ids = list(oracle.accounts.keys())[:20]
+    gen.transfer_ids = sorted(dev.spill.spilled)[:30]
+    gen.pending_ids = [
+        t.id for t in oracle.transfers.values() if t.flags & 2
+    ][:10]
+    op, events = gen.gen_transfers_batch(48)
+    ts = 2_000_000_000
+    dense_o = oracle.execute_dense(op, ts, events)
+    dev.mode = "serial"
+    dense_d = dev.execute_dense(op, ts, events)
+    assert dense_d == dense_o

@@ -37,9 +37,18 @@ class ZoneLayout:
     SUPERBLOCK_COPY_SIZE = 64 * 1024  # header sector + trailers, padded
 
     def __init__(self, cluster: ConfigCluster = DEFAULT_CLUSTER,
-                 grid_size: int = 64 * 1024 * 1024):
+                 grid_size: int = 64 * 1024 * 1024,
+                 forest_blocks: int = 0):
         slot_count = cluster.journal_slot_count
         msg_max = cluster.message_size_max
+        # The grid zone partitions as: two ping-pong snapshot areas | the
+        # LSM forest's block area (`forest_blocks` 128 KiB blocks, for the
+        # spill backing store — 0 when the ledger is HBM-only).
+        self.forest_blocks = forest_blocks
+        forest_size = forest_blocks * cluster.block_size
+        assert forest_size < grid_size, "forest larger than the grid zone"
+        self.snapshot_area_size = (grid_size - forest_size) // 2 // 4096 * 4096
+        self.forest_offset = 2 * self.snapshot_area_size
         self.sizes = {
             Zone.superblock: self.SUPERBLOCK_COPIES * self.SUPERBLOCK_COPY_SIZE,
             Zone.wal_headers: _sector_ceil(slot_count * 128),

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from tigerbeetle_tpu import native
 from tigerbeetle_tpu.io.storage import Storage, Zone
+from tigerbeetle_tpu.lsm.cache import SetAssociativeCache
 from tigerbeetle_tpu.vsr.free_set import FreeSet
 
 BLOCK_SIZE = 128 * 1024  # reference: src/config.zig:140
@@ -32,8 +33,17 @@ class Grid:
         self.offset = offset
         self.block_count = block_count
         self.free_set = FreeSet(block_count)
-        self.cache: dict[int, bytes] = {}  # address -> payload (FIFO-evict)
+        # 16-way CLOCK block cache (reference: src/vsr/grid.zig set-
+        # associative cache over 128 KiB blocks, src/config.zig:112)
+        cap = max(16, (cache_blocks + 15) // 16 * 16)
+        self.cache = SetAssociativeCache(cap)
         self.cache_blocks = cache_blocks
+        # Released blocks stage here until the next checkpoint: the LAST
+        # durable checkpoint's manifest may still reference them, so they
+        # must not be reusable until a free set excluding them is encoded
+        # (reference: src/vsr/superblock_free_set.zig — releases apply at
+        # checkpoint, never mid-interval).
+        self._staged_free: list[int] = []
 
     def _pos(self, address: int) -> int:
         assert 1 <= address <= self.block_count, address
@@ -51,8 +61,12 @@ class Grid:
         return address
 
     def release(self, address: int) -> None:
-        self.free_set.release(address)
-        self.cache.pop(address, None)
+        """Stage the block for release at the NEXT checkpoint (see
+        _staged_free) — crash-restore to the previous checkpoint must still
+        find its contents intact."""
+        assert 1 <= address <= self.block_count, address
+        self._staged_free.append(address)
+        self.cache.remove(address)
 
     # -- IO --
 
@@ -87,14 +101,21 @@ class Grid:
         return payload
 
     def _cache_put(self, address: int, payload: bytes) -> None:
-        if len(self.cache) >= self.cache_blocks:
-            self.cache.pop(next(iter(self.cache)))
-        self.cache[address] = payload
+        self.cache.put(address, payload)
 
     # -- checkpoint trailer --
 
     def encode_free_set(self) -> bytes:
+        """Checkpoint trailer: apply staged releases, THEN encode — the new
+        checkpoint's free set marks replaced blocks free (nothing in its
+        manifests references them), and only once it is durable can they be
+        reused. The caller must not create blocks between this call and the
+        superblock write that records it."""
+        for address in self._staged_free:
+            self.free_set.release(address)
+        self._staged_free.clear()
         return self.free_set.encode()
 
     def restore_free_set(self, data: bytes) -> None:
         self.free_set = FreeSet.decode(data, self.block_count)
+        self._staged_free.clear()

@@ -6,13 +6,20 @@ load-bearing contracts):
 - a mutable in-memory table absorbs puts/removes; on flush it becomes an
   immutable ON-DISK table: sorted (key, value) pairs packed into grid data
   blocks plus one index block of first-keys (binary-searched on lookup);
-- levels 0..n with growth factor 8: lookups cascade memtable -> level 0
-  newest-first -> deeper levels; the first hit wins;
-- compaction merges a level's tables into the next when the level exceeds
-  its budget (k-way merge, newest-wins dedup, tombstone GC at the bottom);
+- level 0 holds overlapping tables newest-first (flush targets); levels
+  >= 1 hold DISJOINT tables sorted by key range (reference invariant,
+  src/lsm/manifest_level.zig), found by binary search on lookup;
+- compaction is PACED: one table per compact step — the over-budget
+  level's victim table merges with the intersecting tables of the next
+  level (k-way, newest-wins dedup), output split into bounded tables,
+  tombstone GC at the bottom (reference: src/lsm/compaction.zig:1-32 one
+  table per half-bar). A flush triggers at most one paced step per level
+  (the half-bar analog), with a 2x-budget backpressure loop as the
+  hard bound;
 - the manifest (table metadata: level, key range, block addresses) is a
   plain structure serialized with the tree's checkpoint (reference keeps a
-  ManifestLog of blocks; here it rides the checkpoint trailer).
+  ManifestLog of blocks; lsm/manifest_log.py provides the incremental
+  block-chain form used by the forest checkpoint).
 
 Tombstone = value of all 0xFF (valid object values never are: wire rows
 carry nonzero ids in the id field's position).
@@ -55,19 +62,40 @@ class TableInfo:
         )
 
 
+def _bisect_table(level: list[TableInfo], key: bytes) -> int | None:
+    """Index of the (disjoint, sorted) table whose range covers key."""
+    lo, hi = 0, len(level) - 1
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        t = level[mid]
+        if key < t.key_min:
+            hi = mid - 1
+        elif key > t.key_max:
+            lo = mid + 1
+        else:
+            return mid
+    return None
+
+
 class Tree:
     def __init__(self, grid: Grid, key_size: int, value_size: int,
-                 memtable_max: int = 4096):
+                 memtable_max: int = 4096, manifest_log=None,
+                 tree_id: int = 0):
         self.grid = grid
+        self.manifest_log = manifest_log  # emits TableInfo churn events
+        self.tree_id = tree_id
         self.key_size = key_size
         self.value_size = value_size
         self.entry_size = key_size + value_size
         self.entries_per_block = BLOCK_PAYLOAD_MAX // self.entry_size
         self.memtable_max = memtable_max
+        self.table_entries_max = memtable_max * 4  # merge output table size
         self.memtable: dict[bytes, bytes] = {}
         self.tombstone = b"\xff" * value_size
-        # levels[0] is newest-first; deeper levels hold older data
+        # levels[0]: overlapping, newest-first. levels[i>=1]: disjoint,
+        # sorted by key range (reference: src/lsm/manifest_level.zig).
         self.levels: list[list[TableInfo]] = [[]]
+        self._compact_cursor: dict[int, int] = {}  # level -> round-robin pos
 
     # -- writes --
 
@@ -88,13 +116,73 @@ class Tree:
         hit = self.memtable.get(key)
         if hit is not None:
             return None if hit == self.tombstone else hit
-        for level in self.levels:
-            for info in level:  # newest-first within a level
-                if info.key_min <= key <= info.key_max:
-                    hit = self._table_get(info, key)
-                    if hit is not None:
-                        return None if hit == self.tombstone else hit
+        for info in self.levels[0]:  # newest-first, overlapping
+            if info.key_min <= key <= info.key_max:
+                hit = self._table_get(info, key)
+                if hit is not None:
+                    return None if hit == self.tombstone else hit
+        for level in self.levels[1:]:  # disjoint: binary search by range
+            i = _bisect_table(level, key)
+            if i is not None:
+                hit = self._table_get(level[i], key)
+                if hit is not None:
+                    return None if hit == self.tombstone else hit
         return None
+
+    def range(self, lo: bytes, hi: bytes) -> list[tuple[bytes, bytes]]:
+        """All live (key, value) pairs with lo <= key <= hi, ascending.
+        Newest-wins across memtable/levels; tombstones excluded (reference:
+        src/lsm/tree.zig:1126-1140 RangeQuery over levels)."""
+        assert len(lo) == self.key_size and len(hi) == self.key_size
+        out: dict[bytes, bytes] = {}
+        # oldest-first so newer entries overwrite: deepest level first, each
+        # level oldest-to-newest (lists are newest-first)
+        for level in reversed(self.levels):
+            for info in reversed(level):
+                if info.key_max < lo or info.key_min > hi:
+                    continue
+                out.update(self._table_range(info, lo, hi))
+        for k, v in self.memtable.items():
+            if lo <= k <= hi:
+                out[k] = v
+        return sorted(
+            (k, v) for k, v in out.items() if v != self.tombstone
+        )
+
+    def _table_range(self, info: TableInfo, lo: bytes,
+                     hi: bytes) -> dict[bytes, bytes]:
+        """One table's entries in [lo, hi]: binary-search the index block for
+        the first candidate data block, then walk blocks until past hi."""
+        index = self.grid.read_block(info.index_address)
+        rec = 8 + self.key_size
+        n = len(index) // rec
+        # last block whose first key <= lo (earlier blocks cannot contain lo)
+        pos = 0
+        a, b = 0, n - 1
+        while a <= b:
+            mid = (a + b) // 2
+            first = index[mid * rec + 8 : mid * rec + 8 + self.key_size]
+            if first <= lo:
+                pos = mid
+                a = mid + 1
+            else:
+                b = mid - 1
+        out: dict[bytes, bytes] = {}
+        e = self.entry_size
+        for i in range(pos, n):
+            first = index[i * rec + 8 : i * rec + 8 + self.key_size]
+            if first > hi:
+                break
+            addr = int.from_bytes(index[i * rec : i * rec + 8], "little")
+            data = self.grid.read_block(addr)
+            for j in range(len(data) // e):
+                k = data[j * e : j * e + self.key_size]
+                if k < lo:
+                    continue
+                if k > hi:
+                    break
+                out[k] = data[j * e + self.key_size : (j + 1) * e]
+        return out
 
     def _table_get(self, info: TableInfo, key: bytes) -> bytes | None:
         index = self.grid.read_block(info.index_address)
@@ -133,8 +221,14 @@ class Tree:
             return
         items = sorted(self.memtable.items())
         self.memtable = {}
-        self.levels[0].insert(0, self._write_table(items))
+        info = self._write_table(items)
+        self.levels[0].insert(0, info)
+        self._log("i", 0, info)
         self._maybe_compact()
+
+    def _log(self, op: str, level: int, info: TableInfo) -> None:
+        if self.manifest_log is not None:
+            self.manifest_log.append(self.tree_id, level, op, info)
 
     def _write_table(self, items: list[tuple[bytes, bytes]]) -> TableInfo:
         index = bytearray()
@@ -154,35 +248,61 @@ class Tree:
         return LEVEL0_TABLES_MAX * (GROWTH_FACTOR ** level)
 
     def _maybe_compact(self) -> None:
+        """At most ONE paced table merge per over-budget level per call
+        (the half-bar analog); a 2x-budget backpressure loop bounds the
+        worst case (reference paces compaction so a level can never run
+        away, src/lsm/compaction.zig:1-32)."""
         for level in range(len(self.levels)):
-            if len(self.levels[level]) > self._level_budget(level):
-                self._compact_level(level)
+            budget = self._level_budget(level)
+            if len(self.levels[level]) > budget:
+                self._compact_one(level)
+            while len(self.levels[level]) > 2 * budget:
+                self._compact_one(level)
 
-    def _compact_level(self, level: int) -> None:
-        """Merge ALL of `level` into `level+1` (the reference paces one
-        table per half-bar; whole-level merges trade pacing for simplicity
-        while preserving the shape: newer level wins, bottom level drops
-        tombstones — reference: src/lsm/compaction.zig:1-32)."""
+    def _compact_one(self, level: int) -> None:
+        """Merge ONE victim table from `level` with the intersecting tables
+        of `level+1`: k-way newest-wins dedup, output split into bounded
+        disjoint tables, tombstone GC at the bottom."""
         if level + 1 >= len(self.levels):
             self.levels.append([])
+        src, dst = self.levels[level], self.levels[level + 1]
+        if level == 0:
+            victim = src.pop()  # oldest level-0 table
+        else:
+            cur = self._compact_cursor.get(level, 0) % len(src)
+            victim = src.pop(cur)
+            self._compact_cursor[level] = cur  # next table shifts into place
+        # intersecting run in the (sorted, disjoint) destination level
+        lo_i = 0
+        while lo_i < len(dst) and dst[lo_i].key_max < victim.key_min:
+            lo_i += 1
+        hi_i = lo_i
+        while hi_i < len(dst) and dst[hi_i].key_min <= victim.key_max:
+            hi_i += 1
         merged: dict[bytes, bytes] = {}
-        # strictly oldest-first so newer entries overwrite: the DEEPER
-        # level's tables (older data) first, each level oldest-to-newest
-        # (lists are newest-first)
-        for info in (
-            list(reversed(self.levels[level + 1]))
-            + list(reversed(self.levels[level]))
-        ):
+        for info in dst[lo_i:hi_i]:  # older data first, victim overwrites
             merged.update(self._read_table(info))
             self.grid_release_table(info)
-        bottom = level + 1 == len(self.levels) - 1
+            self._log("r", level + 1, info)
+        merged.update(self._read_table(victim))
+        self.grid_release_table(victim)
+        self._log("r", level, victim)
+        bottom = (
+            level + 1 == len(self.levels) - 1
+            or all(not lvl for lvl in self.levels[level + 2 :])
+        )
         items = sorted(
             (k, v)
             for k, v in merged.items()
             if not (bottom and v == self.tombstone)  # tombstone GC
         )
-        self.levels[level] = []
-        self.levels[level + 1] = [self._write_table(items)] if items else []
+        out = [
+            self._write_table(items[i : i + self.table_entries_max])
+            for i in range(0, len(items), self.table_entries_max)
+        ]
+        for info in out:
+            self._log("i", level + 1, info)
+        self.levels[level + 1] = dst[:lo_i] + out + dst[hi_i:]
 
     def _read_table(self, info: TableInfo) -> dict[bytes, bytes]:
         out: dict[bytes, bytes] = {}
@@ -204,16 +324,22 @@ class Tree:
             self.grid.release(int.from_bytes(index[i * rec : i * rec + 8], "little"))
         self.grid.release(info.index_address)
 
-    # -- checkpoint --
+    # -- checkpoint (persisted via the ManifestLog, lsm/manifest_log.py) --
 
-    def manifest(self) -> list:
-        """The durable table metadata (flush() first for completeness)."""
-        return [
-            [info.to_json() for info in level] for level in self.levels
-        ]
+    def live_tables(self) -> list:
+        """(tree_id, level, info) of every live table — the manifest log's
+        compaction snapshot input. Level 0 is emitted OLDEST-FIRST: the
+        log's restore replays events chronologically and rebuilds level 0
+        newest-first by reversing, so snapshot events must read like the
+        original insert order."""
+        out = [(self.tree_id, 0, info) for info in reversed(self.levels[0])]
+        for level, tables in enumerate(self.levels[1:], start=1):
+            out += [(self.tree_id, level, info) for info in tables]
+        return out
 
-    def restore_manifest(self, manifest: list) -> None:
-        self.levels = [
-            [TableInfo.from_json(d) for d in level] for level in manifest
-        ]
+    def restore_levels(self, per_level: dict[int, list[TableInfo]]) -> None:
+        """Adopt levels replayed from the manifest log."""
+        n = max(per_level, default=0) + 1
+        self.levels = [per_level.get(i, []) for i in range(max(n, 1))]
         self.memtable = {}
+        self._compact_cursor = {}

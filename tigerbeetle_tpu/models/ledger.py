@@ -65,6 +65,7 @@ from tigerbeetle_tpu.constants import (
     ConfigCluster,
     ConfigProcess,
 )
+from tigerbeetle_tpu.lsm import groove as groove_fields
 from tigerbeetle_tpu.models import validate
 from tigerbeetle_tpu.models.validate import F_LINKED, F_PENDING, F_POST, F_VOID
 from tigerbeetle_tpu.ops import hashtable as ht
@@ -84,6 +85,27 @@ _SLOW_FLAGS = 0b111101
 _SPLIT_SLOW_FLAGS = 0b110001
 
 ROW_WORDS = 32  # 128-byte wire rows as u32 words
+
+# Equality-query field specs: name -> (first u32 word, word count, halfword)
+# — derived from the ONE declaration of the indexed field layouts
+# (lsm/groove.py, mirroring the reference's secondary index trees,
+# src/state_machine.zig:103-206 ids 1-24) so the device filter scan and the
+# LSM index scan can never drift apart per field name.
+
+
+def _query_words(index_fields) -> dict:
+    out = {}
+    for name, off, w in index_fields:
+        assert off % 4 == 0 and w in (2, 4, 8, 16), (name, off, w)
+        out[name] = (off // 4, max(w // 4, 1), w == 2)
+    return out
+
+
+_ACCOUNT_QUERY_WORDS = _query_words(groove_fields.ACCOUNT_INDEX_FIELDS)
+_TRANSFER_QUERY_WORDS = _query_words(groove_fields.TRANSFER_INDEX_FIELDS)
+# Query replies are message-bounded like every other reply (reference:
+# src/state_machine.zig:59-64 — results must fit one message).
+QUERY_LIMIT = 8192
 
 # Sticky fault bits (see module docstring "Fault protocol").
 FAULT_PROBE = 1  # fast-tier lookup window exhausted (batch was a no-op)
@@ -428,6 +450,50 @@ class LedgerKernels:
         )
         self.lookup_accounts = jax.jit(self._lookup_accounts)
         self.lookup_transfers = jax.jit(self._lookup_transfers)
+        self._filters: dict = {}  # (table, field) -> jitted filter scan
+
+    # ------------------------------------------------------------------
+    # secondary-index queries: the TPU-native analog of the reference's
+    # per-field index trees (reference: src/lsm/groove.zig:137-157) over
+    # the RESIDENT store is a vectorized filter scan — the whole table is
+    # in HBM, so an equality query is one fused compare+compact, no index
+    # maintenance on the hot path. (Spilled rows use the LSM index trees,
+    # lsm/groove.py; DeviceLedger.query_* merges the two.)
+    # ------------------------------------------------------------------
+
+    def filter_scan(self, table: str, field: str):
+        """Jitted equality scan over a table: (rows, value_words u32[4]) ->
+        (first QUERY_LIMIT matching rows in slot order, total match count)."""
+        key = (table, field)
+        if key in self._filters:
+            return self._filters[key]
+        spec = (_ACCOUNT_QUERY_WORDS if table == "acct" else
+                _TRANSFER_QUERY_WORDS)[field]
+        word0, nwords, halfword = spec
+        dump = self.a_dump if table == "acct" else self.t_dump
+        K = QUERY_LIMIT
+
+        def scan(rows, val_words):
+            occ = ht.occupied_mask(rows).at[dump].set(False)
+            if halfword:
+                m = (rows[:, word0] & jnp.uint32(0xFFFF)) == val_words[0]
+            else:
+                m = rows[:, word0] == val_words[0]
+                for i in range(1, nwords):
+                    m = m & (rows[:, word0 + i] == val_words[i])
+            mask = occ & m
+            total = jnp.sum(mask.astype(I32))
+            rank = jnp.cumsum(mask.astype(I32)) - 1
+            pos = jnp.where(mask & (rank < K), rank, K)
+            idx = (
+                jnp.full(K + 1, dump, dtype=I32)
+                .at[pos]
+                .set(jnp.arange(rows.shape[0], dtype=I32))[:K]
+            )
+            return rows[idx], total
+
+        self._filters[key] = jax.jit(scan)
+        return self._filters[key]
 
     # ------------------------------------------------------------------
     # create_transfers
@@ -1472,15 +1538,18 @@ class PendingBatch:
     prepare in the reference's pipeline (reference:
     src/vsr/replica.zig:5102-5186, pipeline_prepare_queue_max=8)."""
 
-    __slots__ = ("operation", "n", "results", "flags", "id_limbs", "dense")
+    __slots__ = ("operation", "n", "results", "flags", "id_limbs", "dense",
+                 "epoch")
 
-    def __init__(self, operation, n, results, flags=None, id_limbs=None):
+    def __init__(self, operation, n, results, flags=None, id_limbs=None,
+                 epoch=0):
         self.operation = operation
         self.n = n
         self.results = results  # device u32 [n_pad]
         self.flags = flags  # host u16 [n] (occupancy reconciliation)
         self.id_limbs = id_limbs  # host (lo, hi) u64 [n] (sharded reconcile)
         self.dense = None  # cached drain() result (drain is idempotent)
+        self.epoch = epoch  # occupancy epoch at dispatch (spill reconcile)
 
 
 class DeviceLedger(HostLedgerBase):
@@ -1501,6 +1570,8 @@ class DeviceLedger(HostLedgerBase):
         cluster: ConfigCluster = DEFAULT_CLUSTER,
         process: ConfigProcess = DEFAULT_PROCESS,
         mode: str = "auto",
+        forest=None,
+        spill_keep_frac: float = 0.25,
     ):
         self.cluster = cluster
         self.process = process
@@ -1509,6 +1580,15 @@ class DeviceLedger(HostLedgerBase):
         self.state = init_state(process)
         self.prepare_timestamp = 0
         self.pad_to: int | None = None  # fix the batch pad (bench: 8192)
+        # Optional LSM backing store: with a forest attached, the transfer
+        # table spills its cold tail instead of raising at the load-factor
+        # limit (models/spill.py — the bounded-memory story).
+        self.spill = None
+        self._occupancy_epoch = 0  # bumped by spill cycles (drain reconcile)
+        if forest is not None:
+            from tigerbeetle_tpu.models.spill import SpillManager
+
+            self.spill = SpillManager(self, forest, keep_frac=spill_keep_frac)
         # Host-tracked occupancy for the load-factor guard (1/2 max — the
         # probe-window unresolve probability is ~alpha^window, so alpha <= 1/2
         # with window 32 makes window overflow a ~2^-32 event; see
@@ -1545,13 +1625,17 @@ class DeviceLedger(HostLedgerBase):
         ts = jnp.uint64(timestamp)
         nn = jnp.int32(n)
         if operation == Operation.create_transfers:
+            arr = events if isinstance(events, np.ndarray) else types.transfers_to_np(events)
+            if self.spill is not None:
+                # spill the cold tail / reload referenced spilled rows so
+                # the kernels' HBM lookups see the full store (spill.py)
+                self.spill.admit(arr, n)
             if self._xfer_used + n > self._xfer_limit:
                 raise RuntimeError(
                     f"transfer table at load-factor limit "
                     f"({self._xfer_used}+{n} > {self._xfer_limit}): "
                     "grow ConfigProcess.transfer_slots_log2"
                 )
-            arr = events if isinstance(events, np.ndarray) else types.transfers_to_np(events)
             if self.mode == "auto":
                 decision, slow_mask = self.hazards.split(arr)
             else:  # forced tier (parity tests); the amount bound is unused
@@ -1588,7 +1672,8 @@ class DeviceLedger(HostLedgerBase):
         else:
             raise AssertionError(operation)
         return PendingBatch(
-            operation, n, results, flags=arr["flags"].copy()
+            operation, n, results, flags=arr["flags"].copy(),
+            epoch=self._occupancy_epoch,
         )
 
     def _execute_split(self, arr, n, n_pad, nn, ts, timestamp: int, slow_mask,
@@ -1641,7 +1726,11 @@ class DeviceLedger(HostLedgerBase):
         self.check_fault()
         applied = int(applied_insert_mask(dense, pending.flags).sum())
         if pending.operation == Operation.create_transfers:
-            self._xfer_used += applied - pending.n
+            # A spill cycle after dispatch rebuilt the table and recounted
+            # occupancy exactly — this batch's effect is already measured;
+            # reconciling again would double-count the correction.
+            if pending.epoch == self._occupancy_epoch:
+                self._xfer_used += applied - pending.n
         else:
             self._acct_used += applied - pending.n
         # Cache only AFTER the fault check and reconcile: a drain retried
@@ -1651,6 +1740,77 @@ class DeviceLedger(HostLedgerBase):
 
     def execute_dense(self, operation, timestamp: int, events) -> list[int]:
         return self.drain(self.execute_async(operation, timestamp, events))
+
+    # -- lookups (spill-aware: HBM miss falls back to the LSM store) --
+
+    def lookup_rows(self, operation: Operation, ids: list[int]) -> bytes:
+        if self.spill is None or operation == Operation.lookup_accounts:
+            return super().lookup_rows(operation, ids)
+        found, rows = self._lookup(self.kernels.lookup_transfers, ids)
+        return self.spill.merge_lookup_rows(ids, found, rows)
+
+    def lookup_transfers(self, ids: list[int]) -> list[types.Transfer]:
+        if self.spill is None:
+            return super().lookup_transfers(ids)
+        body = self.lookup_rows(Operation.lookup_transfers, ids)
+        arr = np.frombuffer(body, dtype=types.TRANSFER_DTYPE)
+        return [types.Transfer.from_np(arr[i]) for i in range(len(arr))]
+
+    # -- secondary-index equality queries (device filter scan + LSM tail) --
+
+    def _query_scan(self, table: str, field: str, value: int) -> np.ndarray:
+        words = _ACCOUNT_QUERY_WORDS if table == "acct" else _TRANSFER_QUERY_WORDS
+        _, nwords, halfword = words[field]
+        width_bits = 16 if halfword else nwords * 32
+        if not 0 <= value < (1 << width_bits):
+            raise ValueError(f"{field} value out of range: {value}")
+        vw = np.frombuffer(value.to_bytes(16, "little"), dtype=np.uint32).copy()
+        rows_key = "acct_rows" if table == "acct" else "xfer_rows"
+        rows_d, total_d = self.kernels.filter_scan(table, field)(
+            self.state[rows_key], jnp.asarray(vw)
+        )
+        total = int(np.asarray(total_d))
+        if total > QUERY_LIMIT:
+            raise RuntimeError(
+                f"query matches {total} rows > QUERY_LIMIT {QUERY_LIMIT}"
+            )
+        return np.asarray(rows_d)[:total]
+
+    def query_accounts(self, field: str, value: int) -> list[types.Account]:
+        """Accounts whose `field` equals `value`, ascending timestamp (the
+        analog of a reference index-tree range query; accounts never spill,
+        so the device scan is the whole store)."""
+        rows = self._query_scan("acct", field, value)
+        arr = np.frombuffer(rows.tobytes(), dtype=types.ACCOUNT_DTYPE)
+        out = [types.Account.from_np(arr[i]) for i in range(len(arr))]
+        return sorted(out, key=lambda a: a.timestamp)
+
+    def query_transfers(self, field: str, value: int) -> list[types.Transfer]:
+        """Transfers whose `field` equals `value`, ascending timestamp:
+        device filter scan over HBM merged with the LSM index trees over the
+        spilled tail (lsm/groove.py query)."""
+        rows = self._query_scan("xfer", field, value)
+        arr = np.frombuffer(rows.tobytes(), dtype=types.TRANSFER_DTYPE)
+        by_ts = {
+            int(arr[i]["timestamp"]): types.Transfer.from_np(arr[i])
+            for i in range(len(arr))
+        }
+        if self.spill is not None and self.spill.spilled:
+            g = self.spill.forest.transfers
+            for ts in g.query(field, value):
+                if ts in by_ts:
+                    continue  # HBM wins (stale LSM rows of reloaded ids)
+                row = g.get_by_timestamp(ts)
+                t = types.Transfer.from_np(
+                    np.frombuffer(row, dtype=types.TRANSFER_DTYPE)[0]
+                )
+                if t.id in self.spill.spilled:
+                    by_ts[ts] = t
+            if len(by_ts) > QUERY_LIMIT:
+                raise RuntimeError(
+                    f"query matches {len(by_ts)} rows > QUERY_LIMIT"
+                )
+        return [by_ts[ts] for ts in sorted(by_ts)]
 
     # -- parity extraction --
 
@@ -1678,6 +1838,8 @@ class DeviceLedger(HostLedgerBase):
             transfers[t.id] = t
             if ful[i]:
                 posted[t.timestamp] = int(ful[i])
+        if self.spill is not None:
+            self.spill.extract_into(transfers, posted)
         return accounts, transfers, posted
 
     @property

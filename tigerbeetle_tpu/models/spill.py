@@ -1,0 +1,382 @@
+"""HBM↔LSM spill scheduler: the bounded-memory story.
+
+The device ledger's transfer table is a capacity-bounded HBM hash table
+(models/ledger.py); the reference's store is an unbounded LSM forest with a
+residency-guaranteed in-memory cache (reference: src/lsm/groove.zig:602-760
+prefetch contract; src/lsm/cache_map.zig:10-25 CacheMap residency). This
+module closes that gap the TPU-native way:
+
+- HBM is the CacheMap: every row a batch can touch is resident BEFORE the
+  kernel runs, so the kernels stay pure, synchronous, and data-parallel.
+- The LSM forest (lsm/groove.py over the grid) is the backing store: when
+  HBM occupancy reaches the spill trigger, the OLDEST transfers spill to
+  the forest (timestamp order — the reference's object trees are
+  timestamp-keyed for exactly this access pattern) and the HBM table is
+  rebuilt with only the hot tail. Rebuilding also sheds rollback
+  tombstones, so a cycle resets probe-chain density to the live load.
+- Before every commit, the host checks the batch's id and pending_id
+  references against the spilled-id set (sorted-limb prefilter + exact
+  set — the host analog of the reference's per-table bloom filters,
+  src/lsm/bloom_filter.zig) and RELOADS referenced spilled rows into HBM.
+  This is the prefetch contract: after admit(), the kernels' HBM lookups
+  are equivalent to lookups against the full store.
+
+Accounts do not spill: account rows are the working set of every batch
+(dr/cr balance updates), and the reference's workload shape is a bounded
+account population with unbounded transfer history — the transfer table is
+the wall that matters (BASELINE.md: 10k accounts, 10M+ transfers). The
+account-table guard stays hard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.models.ledger import (
+    FAULT_CAPACITY,
+    FAULT_CLAIM,
+    FAULT_PROBE,
+    raise_on_fault,
+)
+from tigerbeetle_tpu.models.validate import F_POST, F_VOID
+from tigerbeetle_tpu.ops import hashtable as ht
+
+U64 = jnp.uint64
+U32 = jnp.uint32
+ROW_WORDS = 32
+
+CHUNK = 8192  # static shape of gather/reload kernels (= BATCH_PAD)
+
+
+class SpillKernels:
+    """Jitted device ops for the spill cycle, closed over table geometry."""
+
+    def __init__(self, process):
+        self.t_log2 = process.transfer_slots_log2
+        self.t_dump = 1 << self.t_log2
+        self.ts_occ = jax.jit(self._ts_occ)
+        self.gather = jax.jit(self._gather)
+        self.reload = jax.jit(self._reload, donate_argnums=(0, 1, 2))
+
+    def _ts_occ(self, xfer_rows):
+        """Per-slot (timestamp u64, occupied bool) — the cycle's scan."""
+        occ = ht.occupied_mask(xfer_rows).at[self.t_dump].set(False)
+        ts = xfer_rows[:, 30].astype(U64) | (
+            xfer_rows[:, 31].astype(U64) << jnp.uint64(32)
+        )
+        return ts, occ
+
+    def _gather(self, xfer_rows, fulfill, idx):
+        return xfer_rows[idx], fulfill[idx]
+
+    def _reload(self, xfer_rows, fulfill, claim, used_slots, fault,
+                rows_b, ful_b, active):
+        """Insert absent rows (verbatim stored content, fulfill included)
+        into the transfer table. Lanes whose key is already resident are
+        skipped — reload is idempotent. Every write gates on the sticky
+        fault word (models/ledger.py fault protocol)."""
+        key4 = rows_b[:, :4]
+        _, found, res = ht.lookup(key4, xfer_rows, self.t_log2)
+        need = active & ~found
+        slots, claim, ins_res = ht.claim_slots(
+            key4, need, xfer_rows, claim, self.t_log2
+        )
+        n_new = jnp.sum(need).astype(U64)
+        cap_bad = used_slots + n_new > np.uint64(self.t_dump // 2)
+        fault = (
+            fault
+            | jnp.where(jnp.any(active & ~res), jnp.uint32(FAULT_PROBE), jnp.uint32(0))
+            | jnp.where(jnp.any(~ins_res), jnp.uint32(FAULT_CLAIM), jnp.uint32(0))
+            | jnp.where(cap_bad, jnp.uint32(FAULT_CAPACITY), jnp.uint32(0))
+        )
+        proceed = fault == 0
+        w = jnp.where(proceed & need, slots, self.t_dump)
+        xfer_rows = xfer_rows.at[w].set(rows_b)
+        fulfill = fulfill.at[w].set(ful_b)
+        used_slots = used_slots + jnp.where(proceed, n_new, jnp.uint64(0))
+        return xfer_rows, fulfill, claim, used_slots, fault
+
+
+class SpillManager:
+    """Owns the spilled-id set, the LSM backing store, and the cycle.
+
+    Attached to a DeviceLedger via ``DeviceLedger(forest=...)``; the ledger
+    calls ``admit(arr, n)`` before every create_transfers commit and merges
+    spilled rows into lookups/extract.
+    """
+
+    def __init__(self, ledger, forest, keep_frac: float = 0.25):
+        assert 0.0 < keep_frac < 1.0
+        self.ledger = ledger
+        self.forest = forest
+        self.keep_frac = keep_frac
+        self.kernels = SpillKernels(ledger.process)
+        # ids present ONLY in the LSM store (reloading removes the id; the
+        # stale LSM row is overwritten on the next spill of that id).
+        self.spilled: set[int] = set()
+        # Sorted lo-limb prefilter over `spilled` (may carry stale entries
+        # between cycles; exactness comes from the set).
+        self._lo = np.empty(0, dtype=np.uint64)
+        self.stats = {"cycles": 0, "spilled": 0, "reloaded": 0}
+
+    # ------------------------------------------------------------------
+    # membership
+    # ------------------------------------------------------------------
+
+    def _prefilter(self, lo: np.ndarray) -> np.ndarray:
+        """Lanes whose id lo-limb appears in the sorted prefilter."""
+        if len(self._lo) == 0:
+            return np.zeros(len(lo), dtype=bool)
+        pos = np.searchsorted(self._lo, lo)
+        pos_c = np.minimum(pos, len(self._lo) - 1)
+        return self._lo[pos_c] == lo
+
+    def referenced_spilled(self, arr: np.ndarray) -> list[int]:
+        """Distinct spilled ids this batch references: its own ids (the
+        exists/idempotency checks, reference: src/state_machine.zig:767-777,
+        886-905) and post/void pending_id references (reference: :907-1014).
+        """
+        out: set[int] = set()
+        if not self.spilled:
+            return []
+        cand = self._prefilter(arr["id_lo"])
+        for i in np.nonzero(cand)[0]:
+            key = int(arr["id_lo"][i]) | (int(arr["id_hi"][i]) << 64)
+            if key in self.spilled:
+                out.add(key)
+        pv = (arr["flags"] & np.uint16(F_POST | F_VOID)) != 0
+        if pv.any():
+            cand = self._prefilter(arr["pending_id_lo"]) & pv
+            for i in np.nonzero(cand)[0]:
+                key = int(arr["pending_id_lo"][i]) | (
+                    int(arr["pending_id_hi"][i]) << 64
+                )
+                if key in self.spilled:
+                    out.add(key)
+        return sorted(out)
+
+    # ------------------------------------------------------------------
+    # admission: called before every create_transfers commit
+    # ------------------------------------------------------------------
+
+    def admit(self, arr: np.ndarray, n: int) -> None:
+        led = self.ledger
+        # Capacity to free: the CONSERVATIVE occupancy transient, not the
+        # true row growth. True growth is <= n + n_pv (an event's own id
+        # yields a fresh insert OR a reload-then-exists, never both), but
+        # the ledger charges +n at dispatch and only reconciles at drain —
+        # so between reload and drain the counter can read
+        # reloads (<= n + n_pv) + n. `need` must cover that transient or
+        # the hard load guard would raise on a batch that actually fits.
+        n_pv = int(((arr["flags"] & np.uint16(F_POST | F_VOID)) != 0).sum())
+        reload_ids = self.referenced_spilled(arr)
+        if led._xfer_used + n + len(reload_ids) > led._xfer_limit:
+            self.cycle(need=2 * n + n_pv)
+            # the cycle may have spilled rows this batch references
+            reload_ids = self.referenced_spilled(arr)
+        if reload_ids:
+            self._reload_rows(reload_ids)
+
+    def _fetch(self, id_: int) -> tuple[bytes, int]:
+        """One spilled row + fulfill byte from the LSM store."""
+        g = self.forest.transfers
+        ts_key = g.ids.get(g._id_key(id_))
+        assert ts_key is not None, f"spilled id {id_} missing from LSM"
+        row = g.objects.get(ts_key)
+        assert row is not None
+        ful = self.forest.posted.get(ts_key)
+        return row, (ful[0] if ful else 0)
+
+    def _reload_rows(self, ids: list[int]) -> None:
+        led = self.ledger
+        st = led.state
+        for start in range(0, len(ids), CHUNK):
+            chunk = ids[start : start + CHUNK]
+            k = len(chunk)
+            pad = CHUNK if len(ids) > CHUNK else _next_pow2(k)
+            rows = np.zeros((pad, ROW_WORDS), dtype=np.uint32)
+            ful = np.zeros(pad, dtype=np.uint32)
+            for i, id_ in enumerate(chunk):
+                row_bytes, f = self._fetch(id_)
+                rows[i] = np.frombuffer(row_bytes, dtype=np.uint32)
+                ful[i] = f
+            active = np.zeros(pad, dtype=bool)
+            active[:k] = True
+            (
+                st["xfer_rows"], st["fulfill"], st["xfer_claim"],
+                st["xfer_used_slots"], st["fault"],
+            ) = self.kernels.reload(
+                st["xfer_rows"], st["fulfill"], st["xfer_claim"],
+                st["xfer_used_slots"], st["fault"],
+                jnp.asarray(rows), jnp.asarray(ful), jnp.asarray(active),
+            )
+            for id_ in chunk:
+                self.spilled.discard(id_)
+            led._xfer_used += k
+            self.stats["reloaded"] += k
+
+    # ------------------------------------------------------------------
+    # the spill cycle
+    # ------------------------------------------------------------------
+
+    def cycle(self, need: int) -> None:
+        """Spill the cold majority to the LSM forest and rebuild the HBM
+        table with the hot tail, guaranteeing room for `need` new rows.
+        A host-paced maintenance op (the analog of the reference's paced
+        compaction beats trading throughput for bounded memory)."""
+        led = self.ledger
+        st = led.state
+        fault = int(np.asarray(st["fault"]))
+        if fault:
+            raise_on_fault(fault, "spill cycle")
+        ts, occ = self.kernels.ts_occ(st["xfer_rows"])
+        ts = np.asarray(ts)
+        occ = np.asarray(occ)
+        live = int(occ.sum())
+        if led._xfer_limit - need < 0:
+            raise RuntimeError(
+                f"batch needs {need} transfer slots but the table limit is "
+                f"{led._xfer_limit}: grow ConfigProcess.transfer_slots_log2"
+            )
+        keep = min(int(live * self.keep_frac), led._xfer_limit - need)
+        ts_live = np.sort(ts[occ])  # timestamps are unique by construction
+        n_cold = live - keep
+        if n_cold <= 0:
+            return  # nothing live to spill
+        # first KEPT timestamp (keep == 0: spill everything)
+        watermark = (
+            int(ts_live[n_cold]) if n_cold < live else int(ts_live[-1]) + 1
+        )
+        cold = occ & (ts < watermark)
+        hot = occ & (ts >= watermark)
+        cold_idx = np.nonzero(cold)[0].astype(np.int32)
+        hot_idx = np.nonzero(hot)[0].astype(np.int32)
+
+        # 1. Cold rows -> LSM (host pull; insert into groove + posted tree).
+        g = self.forest.transfers
+        for start in range(0, len(cold_idx), CHUNK):
+            idx = cold_idx[start : start + CHUNK]
+            idx_pad = np.full(CHUNK, self.kernels.t_dump, dtype=np.int32)
+            idx_pad[: len(idx)] = idx
+            rows_d, ful_d = self.kernels.gather(
+                st["xfer_rows"], st["fulfill"], jnp.asarray(idx_pad)
+            )
+            rows = np.asarray(rows_d)[: len(idx)]
+            ful = np.asarray(ful_d)[: len(idx)]
+            ids_lo = rows[:, 0].astype(np.uint64) | (
+                rows[:, 1].astype(np.uint64) << np.uint64(32)
+            )
+            ids_hi = rows[:, 2].astype(np.uint64) | (
+                rows[:, 3].astype(np.uint64) << np.uint64(32)
+            )
+            ts_lo = rows[:, 30].astype(np.uint64) | (
+                rows[:, 31].astype(np.uint64) << np.uint64(32)
+            )
+            row_bytes = rows.tobytes()
+            for i in range(len(idx)):
+                id_ = int(ids_lo[i]) | (int(ids_hi[i]) << 64)
+                t = int(ts_lo[i])
+                g.insert(id_, t, row_bytes[i * 128 : (i + 1) * 128])
+                if ful[i]:
+                    self.forest.posted.put(
+                        t.to_bytes(8, "big"), bytes([int(ful[i])])
+                    )
+                self.spilled.add(id_)
+            self.stats["spilled"] += len(idx)
+
+        # 2. Rebuild: fresh table, reinsert the hot tail (device-to-device;
+        #    hot rows never visit the host).
+        cap1 = self.kernels.t_dump + 1
+        new_rows = jnp.zeros((cap1, ROW_WORDS), dtype=U32)
+        new_ful = jnp.zeros(cap1, dtype=U32)
+        new_claim = jnp.full(cap1, ht.CLAIM_FREE, dtype=U32)
+        new_used = jnp.uint64(0)
+        new_fault = jnp.uint32(0)
+        for start in range(0, len(hot_idx), CHUNK):
+            idx = hot_idx[start : start + CHUNK]
+            idx_pad = np.full(CHUNK, self.kernels.t_dump, dtype=np.int32)
+            idx_pad[: len(idx)] = idx
+            rows_d, ful_d = self.kernels.gather(
+                st["xfer_rows"], st["fulfill"], jnp.asarray(idx_pad)
+            )
+            active = np.zeros(CHUNK, dtype=bool)
+            active[: len(idx)] = True
+            new_rows, new_ful, new_claim, new_used, new_fault = (
+                self.kernels.reload(
+                    new_rows, new_ful, new_claim, new_used, new_fault,
+                    rows_d, ful_d, jnp.asarray(active),
+                )
+            )
+        new_fault_host = int(np.asarray(new_fault))
+        if new_fault_host:
+            raise_on_fault(new_fault_host, "spill rebuild")
+        st["xfer_rows"] = new_rows
+        st["fulfill"] = new_ful
+        st["xfer_claim"] = new_claim
+        st["xfer_used_slots"] = new_used
+        led._xfer_used = len(hot_idx)
+        led._occupancy_epoch += 1
+        self._lo = np.sort(
+            np.array([x & ((1 << 64) - 1) for x in self.spilled], dtype=np.uint64)
+        )
+        self.stats["cycles"] += 1
+
+    # ------------------------------------------------------------------
+    # lookup / extract merging
+    # ------------------------------------------------------------------
+
+    def merge_lookup_rows(self, ids: list[int], found: np.ndarray,
+                          rows: np.ndarray) -> bytes:
+        """Reply body: wire rows in request order, HBM hits from the device
+        lookup, spilled hits from the LSM store, misses skipped."""
+        out = []
+        for i, id_ in enumerate(ids):
+            if found[i]:
+                out.append(rows[i].tobytes())
+            elif id_ in self.spilled:
+                out.append(self._fetch(id_)[0])
+        return b"".join(out)
+
+    def extract_into(self, transfers: dict, posted: dict) -> None:
+        """Merge spilled rows into extract() results (parity surface)."""
+        for id_ in self.spilled:
+            row, ful = self._fetch(id_)
+            t = types.Transfer.from_np(
+                np.frombuffer(row, dtype=types.TRANSFER_DTYPE)[0]
+            )
+            transfers[t.id] = t
+            if ful:
+                posted[t.timestamp] = ful
+
+    # ------------------------------------------------------------------
+    # durability
+    # ------------------------------------------------------------------
+
+    def checkpoint_meta(self) -> dict:
+        """Flush the forest and return what a checkpoint must persist.
+        The id list rides the superblock meta here; at larger scale it
+        would move to a dedicated grid block chain (the forest's IdTree
+        already holds a superset — the meta list exists to exclude
+        reloaded-and-stale LSM entries)."""
+        manifest = self.forest.checkpoint()
+        return {
+            "manifest": manifest,
+            "spilled": [str(x) for x in sorted(self.spilled)],
+        }
+
+    def restore(self, meta: dict) -> None:
+        self.forest.restore(meta["manifest"])
+        self.spilled = {int(x) for x in meta["spilled"]}
+        self._lo = np.sort(
+            np.array([x & ((1 << 64) - 1) for x in self.spilled], dtype=np.uint64)
+        )
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p <<= 1
+    return p

@@ -116,6 +116,14 @@ def _is_tomb(k4):
     return jnp.all(k4 == TOMB_WORD, axis=-1)
 
 
+def occupied_mask(rows):
+    """Per-slot liveness of a [N, 32] row table: neither empty nor
+    tombstone (THE definition — spill scans and query filter scans must
+    agree bit-for-bit with the probe kernels' slot encoding)."""
+    k4 = rows[..., :4]
+    return ~_is_empty(k4) & ~_is_tomb(k4)
+
+
 def lookup(key4, rows, cap_log2: int, window: int = WINDOW):
     """Probe for key4 ([..., 4] u32; batched or scalar). ONE window gather,
     branch-free resolve. Returns (slot i32, found bool, resolved bool):

@@ -97,7 +97,7 @@ def snapshot_to_superblock(
     # checkpoint's (sequence numbers may advance without blob writes — view
     # persistence — so parity alone would not alternate correctly).
     area = 1 - state.area
-    area_size = storage.layout.sizes[Zone.grid] // 2
+    area_size = storage.layout.snapshot_area_size
     base = area * area_size
 
     carry = {  # format-time identity survives every checkpoint
@@ -126,6 +126,11 @@ def snapshot_to_superblock(
             **carry,
             **(extra_meta or {}),
         }
+        if getattr(ledger, "spill", None) is not None:
+            # flush the LSM backing store and record its manifest + the
+            # spilled-id set (models/spill.py checkpoint contract); the
+            # forest's grid blocks are durable before storage.sync() below
+            meta["spill"] = ledger.spill.checkpoint_meta()
         assert meta["fault"] == 0, "refusing to checkpoint a faulted ledger"
     else:  # scalar oracle backend (logic-level simulation): one blob
         data = ledger.snapshot_bytes()
@@ -210,6 +215,15 @@ def restore_from_snapshot(
                 dtype=np.uint64,
             )
         )
+        if "spill" in state.meta:
+            if getattr(ledger, "spill", None) is None:
+                raise RuntimeError(
+                    "checkpoint has spilled LSM state but the ledger was "
+                    "constructed without a forest: restoring would silently "
+                    "lose every spilled transfer — pass forest= to "
+                    "DeviceLedger"
+                )
+            ledger.spill.restore(state.meta["spill"])
     ledger.state = dev
     sm.prepare_timestamp = state.prepare_timestamp
 
@@ -227,7 +241,22 @@ class DurableLedger:
         self.storage = storage
         self.cluster = cluster
         self.process = process
-        self.ledger = DeviceLedger(cluster, process, mode=mode)
+        # With a forest block area in the layout, the ledger spills its
+        # cold transfer tail to an LSM forest in the grid zone's tail
+        # (models/spill.py); checkpoints then persist the forest manifest
+        # + spilled-id set in the superblock meta.
+        self.forest = None
+        if storage.layout.forest_blocks:
+            from tigerbeetle_tpu.lsm.grid import Grid
+            from tigerbeetle_tpu.lsm.groove import Forest
+
+            self.forest = Forest(Grid(
+                storage,
+                offset=storage.layout.forest_offset,
+                block_count=storage.layout.forest_blocks,
+            ))
+        self.ledger = DeviceLedger(cluster, process, mode=mode,
+                                   forest=self.forest)
         self.sm = StateMachine(self.ledger, cluster)
         self.journal = Journal(storage, cluster)
         self.superblock = SuperBlock(storage)
