@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Copyhound: find host<->device copy inducers in the device compute path.
+
+The reference's copyhound scans LLVM IR for accidental large memcpys
+(reference: src/copyhound.zig:1-9). The TPU analog of an accidental
+memcpy is an accidental DEVICE SYNC or host round-trip in the compute
+path: `np.asarray(...)` on a device array, `.block_until_ready()`,
+`jax.device_get`, `float()/int()` coercions of device scalars, and
+`.tobytes()` pulls. Each one stalls dispatch (see ops/hashtable.py on why
+dispatch health is the flagship constraint).
+
+This scans ops/, models/, parallel/ for those call sites and compares the
+set against `scripts/copyhound_baseline.json`. NEW sites fail the check:
+either justify the sync (it is on a cold path) and re-baseline with
+--update, or remove it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+BASELINE = ROOT / "scripts" / "copyhound_baseline.json"
+SCAN_DIRS = ("tigerbeetle_tpu/ops", "tigerbeetle_tpu/models",
+             "tigerbeetle_tpu/parallel")
+
+SYNC_CALLS = {"asarray", "block_until_ready", "device_get", "tobytes",
+              "from_dlpack"}
+
+
+def scan() -> dict[str, list[str]]:
+    sites: dict[str, list[str]] = {}
+    for d in SCAN_DIRS:
+        for path in sorted((ROOT / d).rglob("*.py")):
+            rel = str(path.relative_to(ROOT))
+            tree = ast.parse(path.read_text())
+            found = []
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = None
+                if isinstance(f, ast.Attribute) and f.attr in SYNC_CALLS:
+                    name = f.attr
+                elif isinstance(f, ast.Name) and f.id in SYNC_CALLS:
+                    name = f.id
+                if name:
+                    # function context for a stable-ish key
+                    found.append(f"{name}@{node.lineno}")
+            if found:
+                sites[rel] = found
+    return sites
+
+
+def main() -> int:
+    update = "--update" in sys.argv
+    sites = scan()
+    counts = {
+        rel: sorted({s.split("@")[0] for s in v}) and
+        {kind: sum(1 for s in v if s.startswith(kind + "@"))
+         for kind in sorted({s.split("@")[0] for s in v})}
+        for rel, v in sites.items()
+    }
+    if update or not BASELINE.exists():
+        BASELINE.write_text(json.dumps(counts, indent=1, sort_keys=True) + "\n")
+        print(f"baseline written: {BASELINE.name}")
+        return 0
+    base = json.loads(BASELINE.read_text())
+    grew = []
+    for rel, kinds in counts.items():
+        for kind, n in kinds.items():
+            if n > base.get(rel, {}).get(kind, 0):
+                grew.append(f"{rel}: {kind} sites {base.get(rel, {}).get(kind, 0)} -> {n}")
+    if grew:
+        print("copyhound: NEW host-device sync sites in the compute path "
+              "(justify + rerun with --update, or remove):")
+        for g in grew:
+            print(" ", g)
+        return 1
+    print("copyhound: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

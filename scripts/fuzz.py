@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Fuzz loop driver (the reference's scripts/fuzz_loop.sh analog).
+
+Usage:
+    python scripts/fuzz.py                 # all fuzzers, seeds forever
+    python scripts/fuzz.py lsm_tree        # one fuzzer
+    python scripts/fuzz.py --seeds 50      # bounded run (CI)
+    python scripts/fuzz.py --seed 1234 lsm_tree   # replay one seed
+
+Every failure prints the fuzzer name + seed — rerun with --seed to replay
+deterministically (the reference's VOPR seed-replay workflow,
+docs/internals/testing.md).
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from tigerbeetle_tpu.testing.fuzz import ALL_FUZZERS  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("fuzzers", nargs="*", choices=[[], *sorted(ALL_FUZZERS)],
+                   help="which fuzzers (default: all)")
+    p.add_argument("--seeds", type=int, default=0,
+                   help="number of seeds per fuzzer (0 = forever)")
+    p.add_argument("--seed", type=int, help="replay exactly this seed")
+    args = p.parse_args()
+    names = args.fuzzers or sorted(ALL_FUZZERS)
+
+    if args.seed is not None:
+        for name in names:
+            print(f"replay {name} seed={args.seed}")
+            ALL_FUZZERS[name](args.seed)
+        print("ok")
+        return 0
+
+    seed = int(time.time())
+    n = 0
+    while args.seeds == 0 or n < args.seeds:
+        for name in names:
+            t0 = time.time()
+            try:
+                ALL_FUZZERS[name](seed)
+            except Exception:
+                print(f"FAIL {name} seed={seed}", flush=True)
+                raise
+            print(f"ok {name} seed={seed} ({time.time() - t0:.1f}s)",
+                  flush=True)
+        seed += 1
+        n += 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

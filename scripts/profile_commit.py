@@ -99,7 +99,8 @@ def main():
     lat = timeit(lambda: gather_fn(state["xfer_rows"], slots))
     print(f"xfer row gather  (8192x128B): p50={np.percentile(lat,50):.2f}ms")
 
-    lat = timeit(lambda: scatter_fn(state["acct_rows"], slots & jnp.int32((1 << a_log2) - 1), rows_b))
+    lat = timeit(lambda: scatter_fn(
+        state["acct_rows"], slots & jnp.int32((1 << a_log2) - 1), rows_b))
     print(f"acct row scatter (8192x128B): p50={np.percentile(lat,50):.2f}ms")
 
 

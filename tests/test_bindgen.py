@@ -1,0 +1,42 @@
+"""Binding generation: one schema source of truth (types.py) emits every
+language's types (reference: build.zig:687-924 generated bindings).
+The committed files must match regeneration exactly, and the schema must
+cover the full wire surface."""
+
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_bindings_in_sync():
+    r = subprocess.run(
+        [sys.executable, "scripts/bindgen.py", "--check"],
+        cwd=ROOT, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_schema_covers_wire_surface():
+    sys.path.insert(0, str(ROOT / "scripts"))
+    import bindgen
+
+    from tigerbeetle_tpu import types
+
+    sizes = {"u128": 16, "u64": 8, "u32": 4, "u16": 2}
+    for name in ("Account", "Transfer"):
+        assert sum(sizes[k] for _, k in bindgen.SCHEMA[name]) == 128, name
+    assert sum(sizes[k] for _, k in bindgen.SCHEMA["CreateAccountsResult"]) == 8
+    assert len(bindgen.ENUMS["CreateAccountResult"]) == len(
+        types.CreateAccountResult
+    )
+    assert len(bindgen.ENUMS["CreateTransferResult"]) == len(
+        types.CreateTransferResult
+    )
+    # every generated file carries every result-code name
+    go = (ROOT / "clients/go/types.go").read_text()
+    ts = (ROOT / "clients/node/types.ts").read_text()
+    for m in types.CreateTransferResult:
+        assert str(m.value) in go
+        assert m.name in ts

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from tigerbeetle_tpu import types
-from tigerbeetle_tpu.state_machine import decode_results, encode_ids
+from tigerbeetle_tpu.state_machine import encode_ids
 from tigerbeetle_tpu.testing.cluster import Cluster
 from tigerbeetle_tpu.testing.state_checker import (
     assert_convergence,

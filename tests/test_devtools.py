@@ -1,0 +1,24 @@
+"""Dev tools stay green (reference: tidy.zig + copyhound.zig analogs):
+the tree must pass its own lint, and the compute path must not grow new
+host-device sync sites without a deliberate re-baseline."""
+
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run(script):
+    return subprocess.run([sys.executable, f"scripts/{script}"], cwd=ROOT,
+                          capture_output=True, text=True)
+
+
+def test_tidy_clean():
+    r = _run("tidy.py")
+    assert r.returncode == 0, r.stdout
+
+
+def test_copyhound_clean():
+    r = _run("copyhound.py")
+    assert r.returncode == 0, r.stdout

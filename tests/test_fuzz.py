@@ -1,0 +1,14 @@
+"""Bounded runs of every per-structure fuzzer (tier 4; reference:
+build.zig:508-558 fuzz targets). `scripts/fuzz.py` runs the unbounded
+loop; this tier pins a few seeds per structure so regressions surface in
+CI time."""
+
+import pytest
+
+from tigerbeetle_tpu.testing.fuzz import ALL_FUZZERS
+
+
+@pytest.mark.parametrize("name", sorted(ALL_FUZZERS))
+@pytest.mark.parametrize("seed", [1, 2])
+def test_fuzz(name, seed):
+    ALL_FUZZERS[name](seed)

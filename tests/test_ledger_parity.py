@@ -7,7 +7,6 @@ through both implementations; dense result codes must match exactly, and the
 full extracted store state must match periodically.
 """
 
-import numpy as np
 import pytest
 
 from tigerbeetle_tpu.constants import TEST_PROCESS
@@ -114,8 +113,10 @@ def test_serial_linked_chain_rollback_exact():
 
     # chain: ok, ok, FAIL(amount=0) -> all three fail; trailing standalone ok.
     transfers = [
-        Transfer(id=10, debit_account_id=1, credit_account_id=2, amount=5, ledger=1, code=1, flags=1),
-        Transfer(id=11, debit_account_id=2, credit_account_id=3, amount=7, ledger=1, code=1, flags=1),
+        Transfer(id=10, debit_account_id=1, credit_account_id=2, amount=5,
+                 ledger=1, code=1, flags=1),
+        Transfer(id=11, debit_account_id=2, credit_account_id=3, amount=7,
+                 ledger=1, code=1, flags=1),
         Transfer(id=12, debit_account_id=1, credit_account_id=3, amount=0, ledger=1, code=1),
         Transfer(id=13, debit_account_id=1, credit_account_id=2, amount=9, ledger=1, code=1),
     ]

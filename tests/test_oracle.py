@@ -229,7 +229,8 @@ def test_two_phase_post_partial_and_errors():
     run_transfers(sm, [pend], timestamp=10_000)
 
     cases = [
-        (Transfer(id=1, pending_id=1000, flags=int(F.post_pending_transfer | F.void_pending_transfer)),
+        (Transfer(id=1, pending_id=1000,
+                  flags=int(F.post_pending_transfer | F.void_pending_transfer)),
          TR.flags_are_mutually_exclusive),
         (Transfer(id=1, pending_id=1000, flags=int(F.post_pending_transfer | F.pending)),
          TR.flags_are_mutually_exclusive),
@@ -570,7 +571,6 @@ def test_lookup_accounts_and_transfers():
 
 def test_workload_generator_runs():
     from tigerbeetle_tpu.testing.workload import WorkloadGenerator
-    from tigerbeetle_tpu.types import Operation as Op
 
     gen = WorkloadGenerator(seed=7)
     sm = OracleStateMachine()

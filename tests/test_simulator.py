@@ -5,7 +5,7 @@ history, convergence, and bit-exact oracle parity."""
 
 import pytest
 
-from tigerbeetle_tpu.testing.simulator import Simulator, run_simulation
+from tigerbeetle_tpu.testing.simulator import run_simulation
 
 
 @pytest.mark.parametrize("seed", [1, 2, 3, 7, 14])

@@ -2,7 +2,6 @@
 batches — the fast-eligible majority runs vectorized, only the hazard
 residue pays the serial scan, results bit-exact against the oracle."""
 
-import numpy as np
 import pytest
 
 from tigerbeetle_tpu.constants import TEST_PROCESS

@@ -1,12 +1,9 @@
 """View change, repair, and the cluster clock (reference:
 src/vsr/replica.zig:1595-1924 view change; src/vsr/clock.zig Marzullo)."""
 
-import numpy as np
-import pytest
 
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.io.time import DeterministicTime
-from tigerbeetle_tpu.state_machine import encode_ids
 from tigerbeetle_tpu.testing.cluster import Cluster
 from tigerbeetle_tpu.testing.state_checker import (
     assert_convergence,
@@ -246,3 +243,92 @@ def test_restarted_replica_rejoins_current_view():
     assert r0.view == cluster.replicas[1].view
     assert r0.commit_min == cluster.replicas[1].commit_min
     assert_identical_state(cluster.replicas)
+
+
+def test_view_change_survives_torn_slot_on_new_primary():
+    """Protocol-aware recovery: the new primary's OWN copy of an acked-but-
+    uncommitted op has a torn body (valid redundant header, corrupt
+    prepare). The nack merge must keep the op — its header is known and no
+    nack quorum exists — and repair the body from a peer (reference:
+    src/vsr.zig:302-304 nacks; journal decision matrix)."""
+    from tigerbeetle_tpu.io.storage import Zone
+    from tigerbeetle_tpu.vsr.header import Command, Header
+
+    cluster = Cluster(replica_count=3)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(47)
+    _commit_batches(cluster, client, gen, 2)
+    base_commit = cluster.replicas[0].commit_min
+
+    def block(src, dst, data):
+        h = Header.from_bytes(data[:128])
+        return h.command not in (Command.commit, Command.reply)
+
+    cluster.network.filters.append(block)
+    op, events = gen.gen_accounts_batch(16)
+    client.request(op, types.accounts_to_np(events).tobytes())
+    cluster.network.run()
+    assert all(r.op == base_commit + 1 for r in cluster.replicas[1:])
+    # remove ONLY our filter (clear() would also drop the cluster's
+    # detach filter, letting the "dead" primary keep answering DVCs)
+    cluster.network.filters.remove(block)
+
+    # tear the new primary's (replica 1) prepare BODY for the acked op;
+    # the redundant header ring stays intact
+    r1 = cluster.replicas[1]
+    torn_op = base_commit + 1
+    slot = r1.journal.slot_for_op(torn_op)
+    cluster.storages[1].fault(
+        Zone.wal_prepares, slot * r1.journal.msg_max + 300, 128
+    )
+    assert r1.journal.read_prepare(torn_op) is None  # body is gone
+    assert r1.journal.get_header(torn_op) is not None  # header survives
+
+    cluster.detach_replica(0)
+    cluster.run_ticks(60)
+    live = cluster.replicas[1:]
+    assert all(r.status == "normal" for r in live)
+    # the torn op survived (header via nack merge, body repaired from
+    # replica 2) and committed in the new view
+    assert all(r.commit_min == base_commit + 1 for r in live)
+    got = r1.journal.read_prepare(torn_op)
+    assert got is not None  # body repaired into the WAL
+    assert_identical_state(live)
+
+
+def test_view_change_truncates_unreplicated_op_by_nacks():
+    """An op only the dead primary ever prepared must TRUNCATE: every
+    surviving replica's log head is below it (implicit nacks >= the nack
+    quorum), so no possible commit is lost and the cluster moves on."""
+    from tigerbeetle_tpu.vsr.header import Command, Header
+
+    cluster = Cluster(replica_count=3)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(48)
+    _commit_batches(cluster, client, gen, 2)
+    base_commit = cluster.replicas[0].commit_min
+
+    def drop_prepares(src, dst, data):
+        h = Header.from_bytes(data[:128])
+        return not (h.command == Command.prepare and src == 0)
+
+    cluster.network.filters.append(drop_prepares)
+    op, events = gen.gen_accounts_batch(16)
+    client.request(op, types.accounts_to_np(events).tobytes())
+    cluster.network.run()
+    assert cluster.replicas[0].op == base_commit + 1  # primary-only
+    assert all(r.op == base_commit for r in cluster.replicas[1:])
+    cluster.network.filters.remove(drop_prepares)
+    # drop the client's pending request: a retransmit in the new view
+    # would legitimately re-commit the same payload and mask truncation
+    client.in_flight = None
+
+    cluster.detach_replica(0)
+    cluster.run_ticks(60)
+    live = cluster.replicas[1:]
+    assert all(r.status == "normal" for r in live)
+    assert all(r.op == base_commit for r in live)  # truncated
+    # the cluster is live: new work commits in the new view
+    _commit_batches(cluster, client, gen, 1)
+    assert all(r.commit_min == base_commit + 1 for r in live)
+    assert_identical_state(live)

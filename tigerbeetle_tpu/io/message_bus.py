@@ -28,6 +28,31 @@ from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Header
 MESSAGE_SIZE_MAX_DEFAULT = 1 << 20
 
 
+class MessagePool:
+    """Fixed send-buffer accounting (reference: src/message_pool.zig:18-41
+    — the pool is sized exactly from worst-case concurrent use, and
+    exhaustion is BACKPRESSURE, not allocation): sends that would exceed
+    the budget are dropped, which is safe for every VSR message class
+    (the protocol retransmits on its timeouts)."""
+
+    def __init__(self, messages_max: int = 64,
+                 message_size_max: int = MESSAGE_SIZE_MAX_DEFAULT):
+        self.capacity = messages_max * message_size_max
+        self.used = 0
+        self.dropped = 0  # observability: sends refused at the budget
+
+    def try_charge(self, n: int) -> bool:
+        if self.used + n > self.capacity:
+            self.dropped += 1
+            return False
+        self.used += n
+        return True
+
+    def credit(self, n: int) -> None:
+        self.used -= n
+        assert self.used >= 0
+
+
 class _Conn:
     def __init__(self, sock: socket.socket, peer: Address | None = None,
                  connected: bool = True):
@@ -45,12 +70,21 @@ class TCPMessageBus(Network):
         own_address: Address,
         listen: bool = False,
         message_size_max: int = MESSAGE_SIZE_MAX_DEFAULT,
+        messages_max: int = 64,
     ):
         """addresses: replica index -> (host, port). own_address: our
         replica index, or our client id (clients don't listen)."""
         self.addresses = addresses
         self.own = own_address
         self.message_size_max = message_size_max
+        self.pool = MessagePool(messages_max, message_size_max)
+        # Per-connection send cap: one wedged peer (open socket, never
+        # reads -> EAGAIN forever) must not consume the SHARED pool and
+        # starve sends to the healthy quorum (the reference bounds per-
+        # connection send queues the same way, src/message_bus.zig:24-70).
+        self.conn_send_max = max(
+            2, messages_max // max(2, len(addresses))
+        ) * message_size_max
         self.sel = selectors.DefaultSelector()
         self.handlers: dict[Address, Handler] = {}
         self.conns: dict[Address, _Conn] = {}  # peer -> connection
@@ -77,6 +111,11 @@ class TCPMessageBus(Network):
                 conn = self._connect(dst)
             if conn is None:
                 return  # unreachable peer: VSR retransmits cover the loss
+        if len(conn.wbuf) + len(data) > self.conn_send_max:
+            self.pool.dropped += 1
+            return  # this peer is wedged: drop for IT, not for everyone
+        if not self.pool.try_charge(len(data)):
+            return  # pool exhausted: backpressure — VSR retransmits
         conn.wbuf += data
         self._flush(conn)
 
@@ -110,7 +149,9 @@ class TCPMessageBus(Network):
             hello.client = self.own
         hello.set_checksum_body(b"")
         hello.set_checksum()
-        conn.wbuf += hello.to_bytes()
+        frame = hello.to_bytes()
+        self.pool.used += len(frame)  # mandatory frame: charge unconditionally
+        conn.wbuf += frame
         self._flush(conn)
         return conn
 
@@ -131,6 +172,8 @@ class TCPMessageBus(Network):
         except (KeyError, ValueError):
             pass
         conn.sock.close()
+        self.pool.credit(len(conn.wbuf))  # unsent bytes return to the pool
+        conn.wbuf.clear()
         if conn.peer is not None and self.conns.get(conn.peer) is conn:
             del self.conns[conn.peer]
 
@@ -148,6 +191,7 @@ class TCPMessageBus(Network):
             if n <= 0:
                 return
             del conn.wbuf[:n]
+            self.pool.credit(n)
 
     # -- pumping --
 

@@ -178,7 +178,8 @@ class WorkloadGenerator:
                 elif mutation == 9:
                     t.timeout = 5  # timeout without pending
             elif (
-                roll < self.two_phase_rate + self.balancing_rate + self.invalid_rate + self.conflict_rate
+                roll < self.two_phase_rate + self.balancing_rate
+                + self.invalid_rate + self.conflict_rate
             ):
                 pool = self.transfer_ids + batch_created_ids
                 if pool:

@@ -61,6 +61,12 @@ PING_TICKS = 8  # clock sync cadence
 VIEW_CHANGE_TICKS = 40  # backup: silence before starting a view change
 RETRY_TICKS = 16  # view-change message retry cadence
 
+# DVC suffix NACK marker: a synthetic header whose `operation` proves the
+# sender's slot for that op is BLANK — it never prepared the op (the
+# reference's blank header in protocol-aware recovery, src/vsr.zig:302-304).
+# Valid state-machine operations are 128-131; VSR ops are < 128.
+OP_NACK = 255
+
 
 class Replica:
     def __init__(
@@ -654,7 +660,7 @@ class Replica:
         own = self.superblock.state
         assert own is not None
         area = 1 - own.area
-        area_size = self.storage.layout.sizes[Zone.grid] // 2
+        area_size = self.storage.layout.snapshot_area_size
         off = area * area_size
         local_refs = []
         pos = 0
@@ -851,19 +857,72 @@ class Replica:
             self._send_do_view_change()
 
     def _suffix_headers(self) -> list[Header]:
-        """Headers of ops (commit_min, op] — the log suffix the DVC/SV
-        carries (bounded by the pipeline depth)."""
+        """Headers of ops (commit_min, op] — the log suffix an SV carries.
+        Only REAL headers: a normal-status primary has every suffix op
+        readable (adoption required the bodies before it could finish);
+        markers must never reach an SV — a backup would adopt one as a
+        real header and wedge waiting for a prepare whose checksum can
+        never match."""
         out = []
         for op in range(self.commit_min + 1, self.op + 1):
             got = self.journal.read_prepare(op)
-            if got is None:
-                break  # faulty tail slot: advertise only up to the gap
+            assert got is not None, f"SV suffix op {op} unreadable"
             out.append(got[0])
         return out
 
+    def _dvc_suffix_headers(self) -> tuple[list[Header], int]:
+        """(suffix, head) for a DVC: the log evidence in the JOURNAL —
+        NOT the in-memory head, which an earlier unfinished adoption may
+        have truncated to commit_min while acked prepares still sit intact
+        in the WAL (advertising only self.op there would falsely nack
+        them). Per op:
+
+        - readable prepare -> its header;
+        - TORN slot (redundant header survives, body lost) -> that header:
+          authoritative, peers repair the body after adoption (protocol-
+          aware recovery, reference: src/vsr.zig:302-304);
+        - BLANK slot -> an explicit NACK marker, counted toward the nack
+          quorum that authorizes truncation.
+
+        The scan extends past self.op while journal evidence continues; a
+        run of blanks longer than the pipeline depth terminates it (the
+        primary never has more than pipeline_prepare_queue_max prepares in
+        flight, so a longer gap cannot hide acked ops)."""
+        out: list[Header] = []
+        head = self.commit_min
+        gap_max = self.cluster.pipeline_prepare_queue_max
+        op = self.commit_min
+        limit = self.commit_min + self.cluster.journal_slot_count
+        pending: list[Header] = []
+        while op < limit:
+            op += 1
+            # the in-memory redundant-header mirror is authoritative for
+            # slot EVIDENCE (valid and torn slots both carry their header;
+            # the valid/torn distinction only matters for body repair,
+            # which happens after adoption) — no prepare-ring reads here
+            h = self.journal.get_header(op)
+            if h is not None:
+                out.extend(pending)
+                pending = []
+                out.append(h)
+                head = op
+                continue
+            if len(pending) >= gap_max and op > self.op:
+                break  # gap too long to hide acked ops: the log ends
+            nack = Header(
+                command=int(Command.prepare), op=op, operation=OP_NACK
+            )
+            nack.set_checksum_body(b"")
+            nack.set_checksum()
+            pending.append(nack)
+        head = max(head, self.op)
+        # markers for trailing blanks up to our known head still count
+        out.extend(m for m in pending if m.op <= head)
+        return out, head
+
     def _send_do_view_change(self) -> None:
         new_primary = self.view_candidate % self.replica_count
-        suffix = self._suffix_headers()
+        suffix, head = self._dvc_suffix_headers()
         body = b"".join(h.to_bytes() for h in suffix)
         # DVC fields (reference: do_view_change sets request=log_view,
         # commit=commit_min, op=log head; the suffix headers ride the body).
@@ -871,7 +930,7 @@ class Replica:
             command=int(Command.do_view_change),
             view=self.view_candidate,
             request=self.log_view,
-            op=self.commit_min + len(suffix),
+            op=head,
             commit=self.commit_min,
             parent=self.commit_checksum,
             timestamp=self.checkpoint_op,  # my WAL covers (this, op]
@@ -896,22 +955,88 @@ class Replica:
 
     def _record_dvc(self, replica: int, header: Header, suffix: list[Header]):
         self._dvc[replica] = (header, suffix)
-        if self._adopt is None and len(self._dvc) >= self.quorum_view_change:
-            # Choose the best log: max (log_view, op) (reference:
-            # :2845-2977 primary_receive_do_view_change).
-            best_replica, (best_h, best_suffix) = max(
-                self._dvc.items(),
-                key=lambda kv: (kv[1][0].request, kv[1][0].op),
+        if self._adopt is not None or len(self._dvc) < self.quorum_view_change:
+            return
+        # Choose the best log: max (log_view, op) (reference: :2845-2977
+        # primary_receive_do_view_change), then MERGE per op with nack
+        # accounting (protocol-aware recovery, reference:
+        # src/vsr.zig:302-304): an op survives if any best-log_view DVC
+        # carries its header (torn bodies repair later); it truncates only
+        # under a NACK QUORUM proving no replication quorum ever acked it;
+        # otherwise the change waits for more DVCs — guessing could drop
+        # an acked op (data loss) or resurrect a superseded one.
+        best_replica, (best_h, _) = max(
+            self._dvc.items(),
+            key=lambda kv: (kv[1][0].request, kv[1][0].op),
+        )
+        # Nack soundness rests on the WAL durability order (journal.py):
+        # the redundant header is durable BEFORE an op is ever acked, so an
+        # acked op's header survives a torn body and its slot reports TORN
+        # (header, no nack), never BLANK. A false nack therefore requires
+        # post-durability media corruption of BOTH rings' sectors on one
+        # replica COMBINED with the loss of every other acker — beyond-f
+        # faults, the same residual the reference accepts (its simulator
+        # fault atlas guarantees one surviving copy cluster-wide,
+        # reference: src/testing/storage.zig:1-25).
+        best_log_view = best_h.request
+        base = best_h.commit
+        op_max = max(h.op for h, _ in self._dvc.values())
+        commit_max = max(h.commit for h, _ in self._dvc.values())
+        nack_quorum = self.replica_count - self.quorum_replication + 1
+        merged: dict[int, Header] = {}
+        undecided_op = None
+        for op in range(base + 1, op_max + 1):
+            header_for_op = None
+            nacks = 0
+            for _r, (h, sfx) in self._dvc.items():
+                if h.op < op or op <= h.commit:
+                    if h.op < op:
+                        nacks += 1  # implicit nack: log head below op
+                    continue
+                m = next((x for x in sfx if x.op == op), None)
+                if m is None or m.operation == OP_NACK:
+                    nacks += 1
+                elif h.request == best_log_view and header_for_op is None:
+                    # headers are unique per (log_view, op): any best-
+                    # log_view copy is THE header (lower log_views may hold
+                    # superseded prepares and must not contribute)
+                    header_for_op = m
+            if header_for_op is not None:
+                merged[op] = header_for_op
+            elif nacks >= nack_quorum and op > commit_max:
+                break  # provably never acked by a quorum: truncate here
+            else:
+                # No surviving header, and either no nack quorum OR a DVC
+                # proves the op COMMITTED (op <= commit_max, in which case
+                # nacks are contradictory evidence — truncating would drop
+                # an executed op and diverge): refuse to guess.
+                undecided_op = op
+                break
+        if undecided_op is not None:
+            if len(self._dvc) < self.replica_count:
+                # Wait: a further DVC can still decide this op. If the
+                # missing replicas are down, the change re-runs on timeout
+                # with the same inputs — a deliberate LIVENESS sacrifice:
+                # with evidence destroyed on the live set, guessing either
+                # way risks dropping or resurrecting a possible commit
+                # (PAR blocks rather than guesses; service resumes when a
+                # decisive replica returns).
+                return
+            raise RuntimeError(
+                f"view change: op {undecided_op} unrecoverable — no "
+                f"surviving header, {nacks} nacks "
+                f"(quorum {nack_quorum}), commit_max {commit_max}; "
+                "a possible commit would be lost (protocol-aware recovery "
+                "refuses to guess)"
             )
-            commit_max = max(h.commit for h, _ in self._dvc.values())
-            self._begin_adoption(
-                base=best_h.commit,
-                suffix={h.op: h for h in best_suffix},
-                commit_max=commit_max,
-                src=best_replica,
-                tip=best_h.parent,  # checksum of the op at `base`
-                src_checkpoint=best_h.timestamp,
-            )
+        self._begin_adoption(
+            base=base,
+            suffix=merged,
+            commit_max=commit_max,
+            src=best_replica,
+            tip=best_h.parent,  # checksum of the op at `base`
+            src_checkpoint=best_h.timestamp,
+        )
 
     # -- adoption: two phases shared by the new primary (from DVCs) and
     # backups (from SV). Phase 1: chain catch-up of COMMITTED ops up to the
@@ -943,9 +1068,14 @@ class Replica:
                 continue  # our committed prefix already covers it
             got = self.journal.read_prepare(op)
             if got is None or got[0].checksum != h.checksum:
-                if src == self.replica:
-                    raise AssertionError("best log is local but unreadable")
-                self._request_prepare(op, src)
+                # Ask EVERY peer (not just the best-log source): the
+                # adopted header may cover a slot whose BODY is torn on
+                # the source itself (nack merge keeps such ops — any
+                # replica that acked the prepare can serve it; fills are
+                # checksum-verified so duplicates are harmless).
+                for r in range(self.replica_count):
+                    if r != self.replica:
+                        self._request_prepare(op, r)
         self._try_finish_view_change()
 
     CATCHUP_WINDOW = 32
