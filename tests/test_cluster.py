@@ -191,3 +191,62 @@ def test_cluster_pipelined_requests_from_many_clients():
         c.take_reply()
     assert_convergence(cluster.replicas)
     assert_identical_state(cluster.replicas)
+
+
+def test_reply_persisted_across_restart():
+    """A duplicate request arriving AFTER a checkpoint + restart must be
+    answered with the ORIGINAL reply bytes from the client_replies zone
+    (reference: src/vsr/client_replies.zig) — the checkpoint meta strips
+    reply bytes, and ops at/below the checkpoint are not replayed."""
+    cluster = Cluster(replica_count=3)
+    client = cluster.add_client()
+    body = types.accounts_to_np(
+        [types.Account(id=71, ledger=1, code=1)]
+    ).tobytes()
+    h, _ = cluster.execute(client, Operation.create_accounts, body)
+    # checkpoint so the request's op is NOT in the replayed WAL tail
+    for r in cluster.replicas:
+        r.checkpoint()
+    commit = cluster.replicas[0].commit_min
+
+    # full-cluster restart: every replica's reply bytes can only come
+    # from its client_replies zone
+    for i in range(3):
+        cluster.restart_replica(i)
+    cluster.run_ticks(80)
+    normal = [r for r in cluster.replicas if r.status == "normal"]
+    assert normal, [r.status for r in cluster.replicas]
+    for r in normal:
+        assert r.client_table[client.client_id]["reply"] is not None, (
+            r.replica, "reply not restored from the client_replies zone"
+        )
+    primary = next(r for r in normal if r.view % 3 == r.replica)
+
+    # simulate a late retransmit of the original request
+    from tigerbeetle_tpu.vsr.header import Command, Header
+
+    rq = Header(
+        command=int(Command.request),
+        operation=int(Operation.create_accounts),
+        client=client.client_id,
+        context=client.session,
+        request=1,
+    )
+    rq.set_checksum_body(body)
+    rq.set_checksum()
+    seen = []
+
+    def sniff(src, dst, data):
+        h2 = Header.from_bytes(data[:128])
+        if dst == client.client_id and h2.command == Command.reply:
+            seen.append(h2)
+        return True
+
+    cluster.network.filters.append(sniff)
+    cluster.network.send(client.client_id, primary.replica,
+                         rq.to_bytes() + body)
+    cluster.network.run()
+    cluster.network.filters.remove(sniff)
+    assert seen, "no reply to the retransmit"
+    assert seen[0].checksum == h.checksum  # bit-identical original reply
+    assert primary.commit_min == commit  # not re-executed

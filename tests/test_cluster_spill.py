@@ -1,0 +1,66 @@
+"""Multi-replica spill: the bounded-memory store under VSR.
+
+Each replica owns a forest block area in its grid zone (layout
+forest_blocks); commits spill identically on every replica (determinism),
+checkpoints carry the spill meta, and state sync ships the forest blocks
+so a lagging replica adopting a checkpoint gets the spilled tail too
+(reference: src/vsr/sync.zig checkpoint shipping + trailers)."""
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.state_checker import assert_identical_state
+from tigerbeetle_tpu.testing.workload import WorkloadGenerator
+
+KNOBS = dict(
+    ledgers=(1,),
+    invalid_rate=0.0,
+    conflict_rate=0.03,
+    chain_rate=0.0,
+    two_phase_rate=0.1,
+    balancing_rate=0.0,
+    limit_account_rate=0.0,
+)
+
+
+def _submit_transfers(cluster, client, gen, n_batches, size=96):
+    for _ in range(n_batches):
+        op, events = gen.gen_transfers_batch(size)
+        cluster.execute(client, op, types.transfers_to_np(events).tobytes())
+
+
+def test_cluster_spills_identically_and_syncs():
+    cluster = Cluster(replica_count=3, grid_size=64 * 1024 * 1024,
+                      forest_blocks=192)
+    assert all(r.forest is not None for r in cluster.replicas)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(51, **KNOBS)
+
+    op, events = gen.gen_accounts_batch(60)
+    cluster.execute(client, op, types.accounts_to_np(events).tobytes())
+    _submit_transfers(cluster, client, gen, 30)
+
+    # every replica spilled, deterministically the same
+    for r in cluster.replicas:
+        assert r.ledger.spill.stats["cycles"] >= 1, r.replica
+    spilled_sets = [frozenset(r.ledger.spill.spilled) for r in cluster.replicas]
+    assert spilled_sets[0] == spilled_sets[1] == spilled_sets[2]
+    assert len(spilled_sets[0]) > 0
+    assert_identical_state(cluster.replicas)  # extract() merges the tail
+
+    # lag replica 2 beyond the WAL: >journal_slot_count ops while detached,
+    # crossing a checkpoint (interval 60) that carries spill meta
+    cluster.detach_replica(2)
+    _submit_transfers(cluster, client, gen, 66)
+    assert cluster.replicas[0].checkpoint_op > 0
+    assert "spill" in cluster.replicas[0].superblock.state.meta
+
+    cluster.reattach_replica(2)
+    cluster.run_ticks(200)
+    lagger = cluster.replicas[2]
+    head = cluster.replicas[0].commit_min
+    assert lagger.commit_min == head, (lagger.commit_min, head)
+    assert_identical_state(cluster.replicas)
+    # the synced replica's spilled tail matches (forest blocks shipped)
+    assert frozenset(lagger.ledger.spill.spilled) == frozenset(
+        cluster.replicas[0].ledger.spill.spilled
+    )

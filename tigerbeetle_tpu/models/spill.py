@@ -120,6 +120,11 @@ class SpillManager:
         # Sorted lo-limb prefilter over `spilled` (may carry stale entries
         # between cycles; exactness comes from the set).
         self._lo = np.empty(0, dtype=np.uint64)
+        # Grid block chain holding the checkpointed spilled-id set (the
+        # set can exceed the superblock's copy size; only the addresses
+        # ride the superblock meta — the trailer pattern, reference:
+        # src/vsr/superblock.zig:31-34).
+        self._id_chain: list[int] = []
         self.stats = {"cycles": 0, "spilled": 0, "reloaded": 0}
 
     # ------------------------------------------------------------------
@@ -356,20 +361,42 @@ class SpillManager:
     # ------------------------------------------------------------------
 
     def checkpoint_meta(self) -> dict:
-        """Flush the forest and return what a checkpoint must persist.
-        The id list rides the superblock meta here; at larger scale it
-        would move to a dedicated grid block chain (the forest's IdTree
-        already holds a superset — the meta list exists to exclude
-        reloaded-and-stale LSM entries)."""
+        """Persist the spill store: the spilled-id set goes into a grid
+        block chain (it can exceed the superblock copy size; the forest's
+        IdTree holds a superset — this exact set exists to exclude
+        reloaded-and-stale LSM entries), then the forest checkpoint flushes
+        trees, writes the manifest log, and encodes the free set LAST (so
+        the id blocks created here are covered, and the previous chain's
+        staged releases apply)."""
+        from tigerbeetle_tpu.lsm.grid import BLOCK_PAYLOAD_MAX
+
+        g = self.forest.grid
+        for address in self._id_chain:
+            g.release(address)  # staged until the encode below
+        payload = b"".join(
+            x.to_bytes(16, "little") for x in sorted(self.spilled)
+        )
+        per_block = BLOCK_PAYLOAD_MAX // 16 * 16
+        self._id_chain = [
+            g.create_block(payload[i : i + per_block])
+            for i in range(0, len(payload), per_block)
+        ]
         manifest = self.forest.checkpoint()
         return {
             "manifest": manifest,
-            "spilled": [str(x) for x in sorted(self.spilled)],
+            "spilled_blocks": list(self._id_chain),
+            "spilled_count": len(self.spilled),
         }
 
     def restore(self, meta: dict) -> None:
         self.forest.restore(meta["manifest"])
-        self.spilled = {int(x) for x in meta["spilled"]}
+        self._id_chain = list(meta["spilled_blocks"])
+        self.spilled = set()
+        for address in self._id_chain:
+            raw = self.forest.grid.read_block(address)
+            for i in range(0, len(raw), 16):
+                self.spilled.add(int.from_bytes(raw[i : i + 16], "little"))
+        assert len(self.spilled) == int(meta["spilled_count"])
         self._lo = np.sort(
             np.array([x & ((1 << 64) - 1) for x in self.spilled], dtype=np.uint64)
         )

@@ -33,6 +33,7 @@ class Cluster:
         backend_factory=None,
         network: InProcessNetwork | None = None,
         seed: int = 0,
+        forest_blocks: int = 0,
     ):
         from tigerbeetle_tpu.constants import TEST_CLUSTER, TEST_PROCESS
 
@@ -42,7 +43,8 @@ class Cluster:
         self.time = DeterministicTime()
         self.mode = mode
         self.backend_factory = backend_factory
-        self.layout = ZoneLayout(self.cluster_config, grid_size=grid_size)
+        self.layout = ZoneLayout(self.cluster_config, grid_size=grid_size,
+                                 forest_blocks=forest_blocks)
         self.storages = []
         self.replicas: list[Replica] = []
         self.clients: list[Client] = []

@@ -1,0 +1,54 @@
+"""ClientReplies: the latest reply per client, persisted in the dedicated
+client_replies zone.
+
+The reference stores one message-sized slot per client session
+(reference: src/vsr/client_replies.zig; zone sizing clients_max x
+message_size_max, src/vsr.zig:59-108), so a primary can answer a
+duplicate request with the ORIGINAL reply bytes even after a restart —
+without it, a retransmit arriving after recovery would have to be dropped
+(re-executing is forbidden: exactly-once semantics).
+
+A slot is validated on read against the reply checksum recorded in the
+checkpointed client table: a torn write, a stale slot from an evicted
+session, or bytes predating a state sync all fail the match and read as
+absent (the caller falls back to its reply-lost path; the reference
+additionally repairs reply slots from peers).
+"""
+
+from __future__ import annotations
+
+from tigerbeetle_tpu.constants import ConfigCluster
+from tigerbeetle_tpu.io.storage import Storage, Zone
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
+
+
+class ClientReplies:
+    def __init__(self, storage: Storage, cluster: ConfigCluster):
+        self.storage = storage
+        self.slot_size = cluster.message_size_max
+        self.slot_count = cluster.clients_max
+
+    def write(self, slot: int, wire: bytes) -> None:
+        assert 0 <= slot < self.slot_count
+        assert len(wire) <= self.slot_size
+        self.storage.write(Zone.client_replies, slot * self.slot_size, wire)
+
+    def read(self, slot: int, checksum: int) -> bytes | None:
+        """The slot's reply wire bytes iff intact and matching `checksum`
+        (the client table's record of which reply should be there)."""
+        assert 0 <= slot < self.slot_count
+        raw = self.storage.read(
+            Zone.client_replies, slot * self.slot_size, self.slot_size
+        )
+        header = Header.from_bytes(raw[:HEADER_SIZE])
+        if (
+            not header.valid_checksum()
+            or header.checksum != checksum
+            or header.command != Command.reply
+            or header.size > self.slot_size
+        ):
+            return None
+        body = raw[HEADER_SIZE : header.size]
+        if not header.valid_checksum_body(body):
+            return None
+        return raw[: header.size]

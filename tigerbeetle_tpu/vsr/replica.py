@@ -43,6 +43,7 @@ from tigerbeetle_tpu.io.time import Time
 from tigerbeetle_tpu.models.ledger import DeviceLedger
 from tigerbeetle_tpu.state_machine import StateMachine
 from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.client_replies import ClientReplies
 from tigerbeetle_tpu.vsr.clock import Clock
 from tigerbeetle_tpu.vsr.durable import (
     check_config_fingerprint,
@@ -86,15 +87,31 @@ class Replica:
         self.network = network
         self.time = time
         self.cluster = cluster
-        backend = (
-            backend_factory()
-            if backend_factory is not None
-            else DeviceLedger(cluster, process, mode=mode)
-        )
+        # With a forest block area in the layout, the device ledger spills
+        # its cold transfer tail to an LSM forest in the grid zone's tail
+        # (models/spill.py) — same wiring as the single-replica
+        # DurableLedger; checkpoints carry the spill meta and state sync
+        # ships the forest blocks (see _on_request_sync_checkpoint).
+        self.forest = None
+        if backend_factory is not None:
+            backend = backend_factory()
+        else:
+            if storage.layout.forest_blocks:
+                from tigerbeetle_tpu.lsm.grid import Grid
+                from tigerbeetle_tpu.lsm.groove import Forest
+
+                self.forest = Forest(Grid(
+                    storage,
+                    offset=storage.layout.forest_offset,
+                    block_count=storage.layout.forest_blocks,
+                ))
+            backend = DeviceLedger(cluster, process, mode=mode,
+                                   forest=self.forest)
         self.ledger = backend
         self.sm = StateMachine(backend, cluster)
         self.journal = Journal(storage, cluster)
         self.superblock = SuperBlock(storage)
+        self.client_replies = ClientReplies(storage, cluster)
         self.storage = storage
         self.clock = Clock(replica_index, replica_count, time)
 
@@ -169,6 +186,7 @@ class Replica:
             int(c): dict(e, reply=None)
             for c, e in state.meta.get("client_table", {}).items()
         }
+        self._restore_client_replies()
         persisted_view = int(state.meta.get("view", 0))
         persisted_log_view = int(state.meta.get("log_view", persisted_view))
         self.view = self.log_view = persisted_log_view
@@ -223,7 +241,12 @@ class Replica:
         table rides in the snapshot meta — it is part of the replicated
         state (reference: src/vsr/superblock.zig ClientSessions trailer)."""
         table = {
-            str(c): {"session": e["session"], "request": e["request"]}
+            str(c): {
+                "session": e["session"],
+                "request": e["request"],
+                "slot": e.get("slot"),
+                "reply_checksum": str(e.get("reply_checksum", 0)),
+            }
             for c, e in self.client_table.items()
         }
         snapshot_to_superblock(
@@ -521,6 +544,21 @@ class Replica:
                 self.network.send(self.replica, r, prepare.to_bytes() + body)
         self._maybe_commit_pipeline()
 
+    def _restore_client_replies(self) -> None:
+        """Repopulate reply bytes from the client_replies zone (restart
+        path). Slots are validated against the checkpointed reply
+        checksum, so stale bytes (state sync adopted a foreign table; a
+        torn write; a newer uncheckpointed reply) read as absent and the
+        reply-lost fallbacks apply."""
+        for entry in self.client_table.values():
+            slot = entry.get("slot")
+            want = int(entry.get("reply_checksum", 0) or 0)
+            if slot is None or not want:
+                continue
+            wire = self.client_replies.read(slot, want)
+            if wire is not None:
+                entry["reply"] = wire
+
     def _send_eviction(self, client: int) -> None:
         h = Header(command=int(Command.eviction), client=client)
         self._send(client, h)
@@ -637,7 +675,34 @@ class Replica:
             self.storage.read(Zone.grid, ref.offset, ref.size)
             for ref in state.blobs
         )
-        body = len(payload).to_bytes(8, "little") + payload + blob_bytes
+        # With a spill store, ship the forest's acquired grid blocks too:
+        # the checkpoint's spill meta references them by address, and grid
+        # addresses are layout-relative, so the receiver installs them at
+        # the same addresses in its own forest area. (Shipped in one body
+        # here; the reference ships trailers by bounded chunk —
+        # src/vsr/sync.zig — which is the production path once state
+        # exceeds one message.)
+        forest_section = b""
+        if getattr(self.ledger, "spill", None) is not None:
+            from tigerbeetle_tpu.lsm.grid import BLOCK_SIZE
+
+            grid = self.ledger.spill.forest.grid
+            fo = self.storage.layout.forest_offset
+            blocks = [
+                a for a in range(1, grid.block_count + 1)
+                if not grid.free_set.is_free(a)
+            ]
+            parts = [len(blocks).to_bytes(4, "little")]
+            for a in blocks:
+                raw = self.storage.read(
+                    Zone.grid, fo + (a - 1) * BLOCK_SIZE, BLOCK_SIZE
+                )
+                parts.append(a.to_bytes(8, "little") + raw)
+            forest_section = b"".join(parts)
+        body = (
+            len(payload).to_bytes(8, "little") + payload + blob_bytes
+            + forest_section
+        )
         reply = Header(command=int(Command.sync_manifest))
         self._send(header.replica, reply, body)
 
@@ -649,7 +714,15 @@ class Replica:
         from tigerbeetle_tpu.io.storage import Zone
         from tigerbeetle_tpu.vsr.superblock import BlobRef, VSRState
 
-        if self.status not in ("view_change", "recovering") or self._adopt is None:
+        adopting = (
+            self.status in ("view_change", "recovering")
+            and self._adopt is not None
+        )
+        # A NORMAL-status backup lagging beyond the primary's WAL also
+        # jumps via checkpoint shipping (see _commit_up_to's escalation) —
+        # installing a checkpoint with commit_min above our own only ever
+        # replaces a committed prefix with a longer committed prefix.
+        if not adopting and self.status != "normal":
             return
         n = int.from_bytes(body[:8], "little")
         remote = VSRState.from_bytes(body[8 : 8 + n])
@@ -672,6 +745,25 @@ class Replica:
             self.storage.write(Zone.grid, off, raw)
             local_refs.append(BlobRef(ref.name, off, ref.size, ref.checksum))
             off += (len(raw) + 4095) // 4096 * 4096
+        if pos < len(blob_raw):
+            # forest block section (spill store): install the source's
+            # acquired blocks at the same layout-relative addresses in OUR
+            # forest area; per-block payload checksums verify on first
+            # read, and the spill meta's free set covers the address map
+            if getattr(self.ledger, "spill", None) is None:
+                return  # cannot adopt spilled state without a forest
+            from tigerbeetle_tpu.lsm.grid import BLOCK_SIZE
+
+            fo = self.storage.layout.forest_offset
+            count = int.from_bytes(blob_raw[pos : pos + 4], "little")
+            pos += 4
+            for _ in range(count):
+                a = int.from_bytes(blob_raw[pos : pos + 8], "little")
+                pos += 8
+                raw = blob_raw[pos : pos + BLOCK_SIZE]
+                pos += BLOCK_SIZE
+                self.storage.write(Zone.grid, fo + (a - 1) * BLOCK_SIZE, raw)
+            self.ledger.spill.forest.grid.cache.clear()
         self.storage.sync()
         meta = dict(remote.meta)
         # view durability is OURS, not the sync source's
@@ -695,17 +787,21 @@ class Replica:
             int(c): dict(e, reply=None)
             for c, e in meta.get("client_table", {}).items()
         }
+        self._restore_client_replies()
         self.checkpoint_op = new_state.commit_min
         self.commit_min = self.commit_max = self.op = new_state.commit_min
         self.parent_checksum = self.commit_checksum = new_state.commit_min_checksum
-        # resume adoption from the new base
-        self._catchup.clear()
         self._repair_wanted.clear()
-        self._catchup_no_local = True  # local WAL predates the sync point
-        self._vc_tick = self.ticks
-        self._vc_retries = 0
-        self._request_catchup_window()
-        self._try_finish_view_change()
+        if adopting:
+            # resume adoption from the new base
+            self._catchup.clear()
+            self._catchup_no_local = True  # local WAL predates the sync point
+            self._vc_tick = self.ticks
+            self._vc_retries = 0
+            self._request_catchup_window()
+            self._try_finish_view_change()
+        # normal status: the next commit heartbeat resumes WAL catch-up
+        # from the new checkpoint via _commit_up_to
 
     # ------------------------------------------------------------------
     # commit
@@ -749,6 +845,21 @@ class Replica:
 
     def _commit_up_to(self, commit_max: int) -> None:
         self.commit_max = max(self.commit_max, commit_max)
+        # Beyond-WAL lag: the ops we need have been overwritten in the
+        # primary's ring (it keeps at most journal_slot_count, and
+        # checkpoints every checkpoint_interval) — prepare repair cannot
+        # progress; jump via checkpoint shipping instead (reference:
+        # src/vsr/sync.zig — sync is not only a view-change concern).
+        if (
+            self.commit_max - self.commit_min
+            >= self.cluster.checkpoint_interval
+            and not self.is_primary
+        ):
+            rq = Header(command=int(Command.request_sync_manifest))
+            self._send(self.primary_index, rq)
+            # fall through to WAL repair as well: at the boundary the
+            # primary's checkpoint may not yet be ahead of our commit
+            # (sync reply would be stale) while its ring still covers us
         while self.commit_min < self.commit_max:
             op = self.commit_min + 1
             if op > self.op:
@@ -782,10 +893,21 @@ class Replica:
             self.aof.append(header, body)  # durable before the reply
         operation = Operation(header.operation)
         if operation == Operation.register:
+            used = {
+                e.get("slot") for e in self.client_table.values()
+            } - {None}
+            free = [
+                i for i in range(self.client_replies.slot_count)
+                if i not in used
+            ]
             self.client_table[header.client] = {
                 "session": header.op,
                 "request": 0,
                 "reply": None,
+                # reply-persistence slot (reference: client_replies.zig);
+                # None once clients_max sessions exist — that reply simply
+                # isn't persisted (the reference evicts instead)
+                "slot": free[0] if free else None,
             }
             reply_body = header.op.to_bytes(8, "little")  # session number
         else:
@@ -812,6 +934,11 @@ class Replica:
         if entry is not None:
             entry["request"] = header.request
             entry["reply"] = wire
+            entry["reply_checksum"] = reply.checksum
+            if entry.get("slot") is not None:
+                # persist so a post-restart primary can answer a duplicate
+                # with the ORIGINAL bytes (reference: client_replies.zig)
+                self.client_replies.write(entry["slot"], wire)
         return wire
 
     # ------------------------------------------------------------------
