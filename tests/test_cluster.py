@@ -250,3 +250,125 @@ def test_reply_persisted_across_restart():
     assert seen, "no reply to the retransmit"
     assert seen[0].checksum == h.checksum  # bit-identical original reply
     assert primary.commit_min == commit  # not re-executed
+
+
+def test_commit_window_overlaps_journal_and_device():
+    """Commit-stage overlap (reference: src/vsr/replica.zig:52-70): with
+    commit_window > 0 the primary DISPATCHES a device commit and returns —
+    the next op's journal write and broadcast run while the previous
+    batch's results are still on device (un-drained). Replies flow on
+    flush_commits()."""
+    cluster = Cluster(replica_count=1)
+    r = cluster.replicas[0]
+    c1 = cluster.add_client()
+    c2 = cluster.add_client()
+    r.commit_window = 4
+
+    gen = WorkloadGenerator(61)
+    op, events = gen.gen_accounts_batch(16)
+    body1 = types.accounts_to_np(events).tobytes()
+    op2, events2 = gen.gen_accounts_batch(16)
+    body2 = types.accounts_to_np(events2).tobytes()
+
+    base = r.commit_min
+    c1.request(op, body1)
+    c2.request(op2, body2)
+    cluster.network.run()
+
+    # Both ops are journaled AND dispatched (commit_min advanced) — op 2's
+    # journal write happened while op 1's device batch was still in
+    # flight — but neither has been drained or replied to yet.
+    assert r.commit_min == base + 2
+    assert len(r._inflight) == 2
+    for entry in r._inflight:
+        handle = entry["handle"]
+        assert handle is not None and not isinstance(handle, bytes)
+        assert handle[1].dense is None  # results still on device
+    assert r.journal.read_prepare(base + 1) is not None
+    assert r.journal.read_prepare(base + 2) is not None
+    assert c1.reply is None and c2.reply is None
+
+    # flush finalizes in op order and the replies go out
+    r.flush_commits()
+    cluster.network.run()
+    h1, r1 = c1.take_reply()
+    h2, r2 = c2.take_reply()
+    assert h1.op == base + 1 and h2.op == base + 2
+
+    # the deferred replies are also in the client table + replies zone
+    for c in (c1, c2):
+        e = r.client_table[c.client_id]
+        assert e["reply"] is not None and e.get("slot") is not None
+
+    # a retransmit while dispatched-but-unfinalized must not re-execute:
+    # covered by the _inflight scan in _on_request (regression guard)
+    c1.request(op, body1)
+    cluster.network.run()
+    commit_after_dispatch = r.commit_min
+    c1.resend()  # retransmit while dispatched-but-unfinalized
+    cluster.network.run()
+    r.flush_commits()
+    cluster.network.run()
+    assert r.commit_min == commit_after_dispatch  # executed exactly once
+    c1.take_reply()
+
+
+def test_client_eviction_at_clients_max():
+    """clients_max+1 sessions: the OLDEST session is evicted (not silently
+    left unpersisted), the evicted client learns via the eviction command,
+    and every other session still answers duplicates from the table
+    (reference: src/vsr/replica.zig:3758-3860, src/vsr.zig:136)."""
+    from tigerbeetle_tpu.constants import ConfigCluster
+
+    small = ConfigCluster(
+        journal_slot_count=64, lsm_batch_multiple=4, clients_max=4,
+    )
+    cluster = Cluster(replica_count=3, cluster=small)
+    clients = [cluster.add_client() for _ in range(4)]
+    primary = cluster.replicas[0]
+    assert len(primary.client_table) == 4
+
+    newcomer = cluster.add_client()  # 5th session: evicts the oldest
+    assert len(primary.client_table) == 4
+    assert clients[0].client_id not in primary.client_table
+    assert clients[0].evicted  # the eviction command reached it
+    # every replica evicted the SAME session (deterministic choice)
+    for r in cluster.replicas:
+        assert clients[0].client_id not in r.client_table
+
+    # surviving + new sessions still transact, duplicates still answered
+    gen = WorkloadGenerator(71)
+    op, events = gen.gen_accounts_batch(8)
+    body = types.accounts_to_np(events).tobytes()
+    clients[1].request(op, body)
+    wire = clients[1].in_flight
+    cluster.network.run()
+    clients[1].take_reply()
+    commit = primary.commit_min
+    cluster.network.send(clients[1].client_id, 0, wire)  # late duplicate
+    cluster.network.run()
+    assert primary.commit_min == commit  # answered from table, no re-commit
+    op2, events2 = gen.gen_accounts_batch(8)
+    cluster.execute(newcomer, op2, types.accounts_to_np(events2).tobytes())
+    assert_identical_state(cluster.replicas)
+
+
+def test_evicted_client_request_rejected():
+    """A request on an evicted session gets the eviction command, not an
+    execution."""
+    from tigerbeetle_tpu.constants import ConfigCluster
+
+    small = ConfigCluster(
+        journal_slot_count=64, lsm_batch_multiple=4, clients_max=2,
+    )
+    cluster = Cluster(replica_count=3, cluster=small)
+    c0 = cluster.add_client()
+    cluster.add_client()
+    cluster.add_client()  # evicts c0
+    assert c0.evicted
+    commit = cluster.replicas[0].commit_min
+    gen = WorkloadGenerator(72)
+    op, events = gen.gen_accounts_batch(8)
+    c0.request(op, types.accounts_to_np(events).tobytes())
+    cluster.network.run()
+    assert cluster.replicas[0].commit_min == commit  # not executed

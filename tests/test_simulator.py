@@ -67,3 +67,45 @@ def test_simulation_device_backend():
         crash_probability=0.003,
     )
     assert stats["committed_ops"] > 5
+
+
+def test_simulation_torn_writes_and_zone_faults():
+    """Crash-point torn writes (prepare and/or redundant header cut at
+    crash) plus client_replies + superblock copy corruption on restart —
+    the full zone fault envelope under the atlas rule (reference:
+    src/testing/storage.zig:1-25, src/simulator.zig:160-173)."""
+    stats = run_simulation(
+        11,
+        ticks=900,
+        crash_probability=0.008,
+        restart_ticks_max=40,
+        torn_write_probability=1.0,
+        replies_fault_probability=0.5,
+        superblock_fault_probability=0.5,
+    )
+    assert stats["crashes"] >= 2
+    assert (
+        stats["torn_writes"] + stats["replies_faults"]
+        + stats["superblock_faults"] >= 2
+    )
+    assert stats["committed_ops"] > 20
+
+
+def test_simulation_five_replicas():
+    """A 5-replica cluster (quorum 3) under crashes and the widened
+    partition modes (isolate-single / uniform-size / single-link,
+    symmetric and asymmetric)."""
+    from tigerbeetle_tpu.testing.packet_simulator import PacketSimulatorOptions
+
+    stats = run_simulation(
+        17,
+        ticks=800,
+        replica_count=5,
+        crash_probability=0.004,
+        options=PacketSimulatorOptions(
+            packet_loss_probability=0.02,
+            packet_replay_probability=0.02,
+            partition_probability=0.01,
+        ),
+    )
+    assert stats["committed_ops"] > 20

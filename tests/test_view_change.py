@@ -296,6 +296,61 @@ def test_view_change_survives_torn_slot_on_new_primary():
     assert_identical_state(live)
 
 
+def test_adoption_invalidates_superseded_journal_evidence():
+    """A replica whose tail was truncated by adoption must destroy the
+    journal evidence above the new head — otherwise the next view change's
+    DVC scan (_dvc_suffix_headers reads the header mirror past self.op)
+    re-advertises the superseded headers under the replica's NEW log_view,
+    where best-log merging treats them as authoritative and a truncated
+    prepare can shadow the op committed in the intervening view."""
+    from tigerbeetle_tpu.vsr.header import Command, Header
+
+    cluster = Cluster(replica_count=3)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(49)
+    _commit_batches(cluster, client, gen, 2)
+    base = cluster.replicas[0].commit_min
+
+    # op X = base+1 prepared ONLY by the primary (drop its prepares)
+    def drop_prepares(src, dst, data):
+        h = Header.from_bytes(data[:128])
+        return not (h.command == Command.prepare and src == 0)
+
+    cluster.network.filters.append(drop_prepares)
+    op, events = gen.gen_accounts_batch(16)
+    client.request(op, types.accounts_to_np(events).tobytes())
+    cluster.network.run()
+    r0 = cluster.replicas[0]
+    assert r0.op == base + 1
+    cluster.network.filters.remove(drop_prepares)
+    client.in_flight = None
+
+    # view change truncates X; then the old primary rejoins and adopts
+    cluster.detach_replica(0)
+    cluster.run_ticks(60)
+    assert all(r.op == base for r in cluster.replicas[1:])
+    cluster.reattach_replica(0)
+    cluster.run_ticks(60)
+    assert r0.status == "normal" and r0.view >= 1
+    assert r0.op == base  # tail truncated by adoption
+
+    # the superseded evidence above the head must be GONE — from the
+    # mirror, and from disk (a restart rebuilds the mirror from the rings)
+    assert r0.journal.get_header(base + 1) is None
+    assert r0.journal.read_prepare(base + 1) is None
+    suffix, head = r0._dvc_suffix_headers()
+    assert head == base
+    assert all(h.op <= base for h in suffix)
+    r0b = cluster.restart_replica(0)
+    cluster.run_ticks(60)
+    assert r0b.journal.get_header(base + 1) is None or (
+        r0b.op >= base + 1  # unless a NEW op legitimately took the slot
+    )
+    # and the cluster still commits new work
+    _commit_batches(cluster, client, gen, 1)
+    assert_identical_state(cluster.replicas)
+
+
 def test_view_change_truncates_unreplicated_op_by_nacks():
     """An op only the dead primary ever prepared must TRUNCATE: every
     surviving replica's log head is below it (implicit nacks >= the nack

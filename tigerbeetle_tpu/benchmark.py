@@ -1,0 +1,282 @@
+"""End-to-end benchmark driver: the BASELINE protocol through the FULL
+system.
+
+The reference measures its headline number by formatting a data file,
+starting a real replica process, and driving create_transfers through a
+client over the wire at batch=8190 (reference: scripts/benchmark.sh:34-78,
+src/benchmark.zig:23-73: 10k accounts, 10M transfers, batch latency
+percentiles printed at the end). This module is that harness for the TPU
+build: a real `tigerbeetle_tpu start` server process (WAL on, consensus
+path, TCP), driven by native session clients.
+
+Unlike the reference's single sequential client, several clients each keep
+one request in flight (the replica's commit window overlaps their journal
+writes and device commits — reference: src/vsr/replica.zig:52-70); pass
+clients=1 for the strictly sequential protocol.
+
+Used by bench.py (reported as `durable_tps` alongside the kernel flagship
+number) and by tests/test_process.py's smoke test (tiny sizes, CPU
+backend).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from tigerbeetle_tpu.types import ACCOUNT_DTYPE, TRANSFER_DTYPE, Operation
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BATCH = 8190  # (1 MiB - 128 B) / 128 B
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _accounts_body(start_id: int, count: int) -> bytes:
+    arr = np.zeros(count, dtype=ACCOUNT_DTYPE)
+    arr["id_lo"] = np.arange(start_id, start_id + count, dtype=np.uint64)
+    arr["ledger"] = 1
+    arr["code"] = 1
+    return arr.tobytes()
+
+
+def _transfers_body(rng, start_id: int, count: int, n_accounts: int) -> bytes:
+    arr = np.zeros(count, dtype=TRANSFER_DTYPE)
+    # id_order=reversed (reference: src/benchmark.zig:66-73 default)
+    arr["id_lo"] = np.arange(
+        start_id + count - 1, start_id - 1, -1, dtype=np.uint64
+    )
+    dr = rng.integers(1, n_accounts + 1, size=count, dtype=np.uint64)
+    off = rng.integers(1, n_accounts, size=count, dtype=np.uint64)
+    arr["debit_account_id_lo"] = dr
+    arr["credit_account_id_lo"] = (dr - 1 + off) % n_accounts + 1
+    arr["amount_lo"] = 1
+    arr["ledger"] = 1
+    arr["code"] = 1
+    return arr.tobytes()
+
+
+class _BenchClient:
+    """One session: its own TCP connection + vsr Client, one request in
+    flight, per-batch latency recorded."""
+
+    def __init__(self, client_id: int, port: int):
+        from tigerbeetle_tpu.io.message_bus import TCPMessageBus
+        from tigerbeetle_tpu.vsr.client import Client
+
+        self.bus = TCPMessageBus([("127.0.0.1", port)], client_id)
+        self.client = Client(client_id, self.bus, replica_count=1)
+        self.sent_at = 0.0
+        self.latencies_ms: list[float] = []
+        self.replies: list[bytes] = []
+
+    def pump(self) -> None:
+        self.bus.pump(timeout=0.0)
+
+    def wait_reply(self, deadline_s: float = 120.0) -> tuple:
+        t0 = time.monotonic()
+        while self.client.reply is None:
+            self.pump()
+            if time.monotonic() - t0 > deadline_s:
+                raise TimeoutError("benchmark client: no reply")
+            if self.client.reply is None:
+                time.sleep(0.0001)
+        return self.client.take_reply()
+
+    def register(self) -> None:
+        self.client.register()
+        self.wait_reply()
+
+
+def run_e2e(
+    n_accounts: int = 10_000,
+    n_transfers: int = 1_000_000,
+    batch: int = BATCH,
+    clients: int = 4,
+    warmup_batches: int = 2,
+    jax_platform: str | None = None,
+    tmpdir: str | None = None,
+    server_args: tuple[str, ...] = (),
+    log=None,
+) -> dict:
+    """Format, start a real replica, drive the protocol, return metrics.
+
+    The server process owns the accelerator; this process stays host-only
+    (numpy + sockets) so both can run on a machine with one TPU chip."""
+    log = log or (lambda *_: None)
+    own_tmp = tmpdir is None
+    if own_tmp:
+        tmp = tempfile.TemporaryDirectory(prefix="tb_bench_")
+        tmpdir = tmp.name
+    path = os.path.join(tmpdir, "bench.tigerbeetle")
+    port = free_port()
+
+    slots_log2 = 14
+    while n_transfers + (warmup_batches + 1) * batch > (1 << slots_log2) // 2:
+        slots_log2 += 1
+    acct_log2 = max(14, (n_accounts * 2 + 2).bit_length())
+
+    # prepend (not replace) PYTHONPATH: the TPU runtime may be provided by
+    # a site dir already on it
+    pp = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, PYTHONPATH=f"{REPO}:{pp}" if pp else REPO)
+    if jax_platform:
+        env["TB_JAX_PLATFORM"] = jax_platform
+    fmt = subprocess.run(
+        [sys.executable, "-m", "tigerbeetle_tpu", "format",
+         "--cluster", "0", "--replica", "0", "--replica-count", "1", path],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert fmt.returncode == 0, fmt.stderr
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tigerbeetle_tpu", "start",
+         "--addresses", f"127.0.0.1:{port}",
+         "--account-slots-log2", str(acct_log2),
+         "--transfer-slots-log2", str(slots_log2),
+         *server_args, path],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        line = proc.stdout.readline()  # blocks until ready (TPU init)
+        if "listening" not in line:
+            rest = proc.stdout.read()
+            raise RuntimeError(f"bench server failed to start: {line}{rest}")
+        log(f"server up on :{port} (slots 2^{slots_log2})")
+        return _drive(
+            proc, port, n_accounts, n_transfers, batch, clients,
+            warmup_batches, log,
+        )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        if own_tmp:
+            tmp.cleanup()
+
+
+def _drive(proc, port, n_accounts, n_transfers, batch, clients,
+           warmup_batches, log) -> dict:
+    from tigerbeetle_tpu.state_machine import decode_results
+
+    rng = np.random.default_rng(42)
+    sessions = [_BenchClient(0xB0000 + i, port) for i in range(clients)]
+    for s in sessions:
+        s.register()
+    log(f"{clients} session(s) registered")
+
+    # -- accounts (absorbs the create_accounts compile) --
+    t0 = time.monotonic()
+    next_id = 1
+    while next_id <= n_accounts:
+        n = min(batch, n_accounts - next_id + 1)
+        sessions[0].client.request(
+            Operation.create_accounts, _accounts_body(next_id, n)
+        )
+        _h, body = sessions[0].wait_reply()
+        assert body == b"", "account create failed"
+        next_id += n
+    log(f"{n_accounts} accounts in {time.monotonic() - t0:.1f}s")
+
+    # -- build all transfer bodies up front (workload gen off the clock) --
+    bodies = []
+    next_id = 1_000_000
+    remaining = n_transfers + warmup_batches * batch
+    while remaining > 0:
+        n = min(batch, remaining)
+        bodies.append(_transfers_body(rng, next_id, n, n_accounts))
+        next_id += n
+        remaining -= n
+
+    # -- warmup (create_transfers compile) --
+    for b in bodies[:warmup_batches]:
+        sessions[0].client.request(Operation.create_transfers, b)
+        _h, body = sessions[0].wait_reply()
+        assert body == b"", decode_results(body, Operation.create_transfers)[:3]
+    work = bodies[warmup_batches:]
+    log(f"warmup done ({warmup_batches} batches); timing {len(work)} batches")
+
+    # -- timed phase: each session keeps one batch in flight --
+    lat_ms: list[float] = []
+    failures = 0
+    queue = list(reversed(work))  # pop() from the front of the work list
+    inflight: dict[int, float] = {}
+    t_start = time.monotonic()
+    for s in sessions:
+        if queue:
+            s.client.request(Operation.create_transfers, queue.pop())
+            inflight[s.client.client_id] = time.monotonic()
+    deadline = t_start + max(600.0, n_transfers / 1000)
+    done_batches = 0
+    while inflight:
+        progressed = False
+        for s in sessions:
+            if s.client.client_id not in inflight:
+                continue
+            s.pump()
+            if s.client.reply is None:
+                continue
+            _h, body = s.client.take_reply()
+            lat_ms.append(
+                (time.monotonic() - inflight.pop(s.client.client_id)) * 1e3
+            )
+            failures += len(decode_results(body, Operation.create_transfers))
+            done_batches += 1
+            progressed = True
+            if queue:
+                s.client.request(Operation.create_transfers, queue.pop())
+                inflight[s.client.client_id] = time.monotonic()
+        if not progressed:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"benchmark stalled at batch {done_batches}/{len(work)}"
+                )
+            time.sleep(0.0001)
+    wall = time.monotonic() - t_start
+    n_timed = sum(len(b) // 128 for b in work)
+    assert failures == 0, f"{failures} transfers failed"
+    total = n_timed + warmup_batches * batch  # all committed, amount=1 each
+    return _verify_and_report(
+        sessions[0], n_accounts, total, wall, n_timed, lat_ms, clients, log
+    )
+
+
+def _verify_and_report(session, n_accounts, total, wall, n_timed, lat_ms,
+                       clients, log) -> dict:
+    from tigerbeetle_tpu.state_machine import decode_accounts, encode_ids
+
+    dpo = cpo = found = 0
+    ids = list(range(1, n_accounts + 1))
+    for i in range(0, len(ids), 8000):
+        chunk = ids[i : i + 8000]
+        session.client.request(Operation.lookup_accounts, encode_ids(chunk))
+        _h, body = session.wait_reply()
+        arr = decode_accounts(body)
+        found += len(arr)
+        dpo += int(arr["debits_posted_lo"].sum())
+        cpo += int(arr["credits_posted_lo"].sum())
+    assert found == n_accounts, (found, n_accounts)
+    assert dpo == cpo == total, (dpo, cpo, total)
+    log(f"conservation verified: {total} transfers, dpo==cpo=={total}")
+
+    lat = np.percentile(lat_ms if lat_ms else [float("nan")],
+                        [0, 25, 50, 75, 100])
+    return {
+        "durable_tps": round(n_timed / wall, 1) if wall else 0.0,
+        "n_transfers": n_timed,
+        "wall_s": round(wall, 2),
+        "clients": clients,
+        "latency_ms_p00_p25_p50_p75_p100": [round(float(x), 2) for x in lat],
+    }

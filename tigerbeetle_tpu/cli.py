@@ -10,18 +10,51 @@ composition root, src/tigerbeetle/cli.zig:54-116 flags):
   python -m tigerbeetle_tpu version
   python -m tigerbeetle_tpu repl --addresses=...
 
-`start` is the composition root: FileStorage + TCPMessageBus + RealTime
-injected into the Replica, then the event loop (bus pump + replica ticks at
-tick_ms; reference: main.zig start loop).
+One dataclass per command is the whole CLI surface (the reference derives
+its CLI from structs the same way, src/flags.zig); `flags.parse`
+introspects it. `start` is the composition root: FileStorage +
+TCPMessageBus + RealTime injected into the Replica, then the event loop
+(bus pump + replica ticks at tick_ms; reference: main.zig start loop).
 """
 
 from __future__ import annotations
 
-import argparse
+import dataclasses
 import sys
 import time
 
-VERSION = "0.2.0"
+from tigerbeetle_tpu import flags
+from tigerbeetle_tpu.flags import positional
+
+VERSION = "0.3.0"
+
+
+@dataclasses.dataclass
+class FormatArgs:
+    file: str = positional("data file path")
+    cluster: int = 0
+    replica: int = 0
+    replica_count: int = 1
+    grid_mb: int = 64
+
+
+@dataclasses.dataclass
+class StartArgs:
+    addresses: str  # comma-separated host:port per replica
+    file: str = positional("data file path")
+    replica: int = 0
+    grid_mb: int = 64
+    account_slots_log2: int = 20
+    transfer_slots_log2: int = 24
+    aof: str = ""  # append-only disaster-recovery log path
+    statsd: str = ""  # statsd host:port
+    commit_window: int = 8  # async device commits in flight (0 = sync)
+
+
+@dataclasses.dataclass
+class ReplArgs:
+    addresses: str
+    cluster: int = 0
 
 
 def _parse_addresses(s: str) -> list[tuple[str, int]]:
@@ -84,6 +117,7 @@ def cmd_start(args) -> int:
     )
     if args.aof:
         replica.aof = AOF(args.aof)
+    replica.commit_window = args.commit_window
     statsd = None
     if args.statsd:
         host, _, port = args.statsd.rpartition(":")
@@ -101,7 +135,14 @@ def cmd_start(args) -> int:
     last_debug = time.monotonic()
     last_commit = replica.commit_min
     while True:
-        bus.pump(timeout=tick_s)
+        # With async commits in flight, poll (timeout=0) so a quiet wire
+        # flushes replies immediately; otherwise block one tick.
+        n = bus.pump(timeout=0.0 if replica._inflight else tick_s)
+        if n == 0:
+            # bus idle: drain the async commit window so replies go out
+            # (while frames keep arriving, dispatches pile into the window
+            # and journal/network work overlaps device execution)
+            replica.flush_commits()
         now = time.monotonic()
         if now - last_tick >= tick_s:
             last_tick = now
@@ -130,41 +171,36 @@ def cmd_repl(args) -> int:
     return repl.run(sys.stdin, echo=not sys.stdin.isatty())
 
 
+USAGE = """usage: tigerbeetle_tpu <command> [flags] [file]
+
+commands:
+  format   create a fresh data file
+  start    run a replica
+  version  print version
+  repl     interactive client (alias: client)
+"""
+
+COMMANDS = {
+    "format": (FormatArgs, cmd_format),
+    "start": (StartArgs, cmd_start),
+    "repl": (ReplArgs, cmd_repl),
+    "client": (ReplArgs, cmd_repl),
+}
+
+
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(prog="tigerbeetle_tpu")
-    sub = ap.add_subparsers(dest="command", required=True)
-
-    p = sub.add_parser("format", help="create a fresh data file")
-    p.add_argument("--cluster", type=int, default=0)
-    p.add_argument("--replica", type=int, default=0)
-    p.add_argument("--replica-count", type=int, default=1)
-    p.add_argument("--grid-mb", type=int, default=64)
-    p.add_argument("file")
-    p.set_defaults(fn=cmd_format)
-
-    p = sub.add_parser("start", help="run a replica")
-    p.add_argument("--addresses", required=True,
-                   help="comma-separated host:port per replica")
-    p.add_argument("--replica", type=int, default=0)
-    p.add_argument("--grid-mb", type=int, default=64)
-    p.add_argument("--account-slots-log2", type=int, default=20)
-    p.add_argument("--transfer-slots-log2", type=int, default=24)
-    p.add_argument("--aof", help="append-only disaster-recovery log path")
-    p.add_argument("--statsd", help="statsd host:port")
-    p.add_argument("file")
-    p.set_defaults(fn=cmd_start)
-
-    p = sub.add_parser("version")
-    p.set_defaults(fn=lambda a: print(f"tigerbeetle_tpu {VERSION}") or 0)
-
-    p = sub.add_parser("repl", help="interactive client",
-                       aliases=["client"])
-    p.add_argument("--addresses", required=True)
-    p.add_argument("--cluster", type=int, default=0)
-    p.set_defaults(fn=cmd_repl)
-
-    args = ap.parse_args(argv)
-    return args.fn(args) or 0
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print(USAGE, end="")
+        return 0 if argv else 1
+    command, rest = argv[0], argv[1:]
+    if command == "version":
+        print(f"tigerbeetle_tpu {VERSION}")
+        return 0
+    if command not in COMMANDS:
+        flags.fatal(f"unknown command {command!r}\n{USAGE}")
+    spec, fn = COMMANDS[command]
+    return fn(flags.parse(spec, rest)) or 0
 
 
 if __name__ == "__main__":

@@ -35,7 +35,7 @@ def positional(help_: str = ""):
 
 
 def fatal(msg: str) -> "NoReturn":  # noqa: F821
-    print(f"error: {msg}", file=sys.stderr)
+    sys.stderr.write(f"error: {msg}\n")
     raise SystemExit(1)
 
 
@@ -43,9 +43,34 @@ def _kebab(name: str) -> str:
     return name.replace("_", "-")
 
 
+def usage(spec_cls) -> str:
+    """Generated per-command help: the dataclass IS the flag surface."""
+    hints = get_type_hints(spec_cls)
+    flags_out, pos_out = [], []
+    for f in dataclasses.fields(spec_cls):
+        typ = hints[f.name].__name__
+        if f.metadata.get("positional"):
+            pos_out.append(f"  <{f.name}>  {f.metadata.get('help', '')}")
+            continue
+        default = (
+            "required"
+            if f.default is dataclasses.MISSING
+            and f.default_factory is dataclasses.MISSING
+            else f"default: {f.default!r}"
+        )
+        comment = (f.metadata or {}).get("help", "")
+        flags_out.append(
+            f"  --{_kebab(f.name)} <{typ}>  ({default}) {comment}".rstrip()
+        )
+    return "\n".join(["flags:"] + flags_out + ["arguments:"] + pos_out) + "\n"
+
+
 def parse(spec_cls, argv: list[str]):
     """Parse argv into an instance of the dataclass `spec_cls`."""
     assert dataclasses.is_dataclass(spec_cls)
+    if any(a in ("-h", "--help") for a in argv):
+        sys.stdout.write(usage(spec_cls))
+        raise SystemExit(0)
     hints = get_type_hints(spec_cls)
     by_flag: dict[str, dataclasses.Field] = {}
     positionals: list[dataclasses.Field] = []

@@ -217,18 +217,29 @@ class TCPMessageBus(Network):
                 self._flush(conn)
             if not (mask & selectors.EVENT_READ):
                 continue
-            try:
-                chunk = conn.sock.recv(1 << 16)
-            except OSError as e:
-                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
-                    continue
-                self._close(conn)
-                continue
-            if not chunk:
-                self._close(conn)
-                continue
-            conn.rbuf += chunk
+            # Drain the socket buffer in one turn (a 1 MiB batch frame
+            # spans many TCP segments; one recv per select round would cap
+            # ingest at 64 KiB per event-loop turn). Bounded so one
+            # firehose peer can't starve the rest of the loop. On FIN or
+            # error, buffered frames STILL dispatch before the close —
+            # a one-shot client may send its request and close.
+            closing = False
+            for _ in range(64):
+                try:
+                    chunk = conn.sock.recv(1 << 18)
+                except OSError as e:
+                    if e.errno not in (errno.EAGAIN, errno.EWOULDBLOCK):
+                        closing = True
+                    break
+                if not chunk:
+                    closing = True
+                    break
+                conn.rbuf += chunk
+                if len(chunk) < (1 << 18):
+                    break
             dispatched += self._drain(conn)
+            if closing:
+                self._close(conn)
         # opportunistic write flush
         for conn in list(self.conns.values()):
             if conn.wbuf:

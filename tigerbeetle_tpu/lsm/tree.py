@@ -28,11 +28,47 @@ carry nonzero ids in the id field's position).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 
 from tigerbeetle_tpu.lsm.grid import BLOCK_PAYLOAD_MAX, Grid
 
 GROWTH_FACTOR = 8  # reference: src/config.zig:142
 LEVEL0_TABLES_MAX = 4
+
+# Split-block-style bloom filter (reference: src/lsm/bloom_filter.zig):
+# ~10 bits/key, 4 probes -> ~1-2% false positives. The filter is its own
+# grid block per table, consulted before any index/data block read.
+FILTER_BITS_PER_KEY = 10
+FILTER_PROBES = 4
+
+
+def _filter_probes(key: bytes, nbits: int):
+    """Deterministic probe positions (blake2b — never Python's salted
+    hash(): filter bytes live in checksummed grid blocks shared across
+    replicas)."""
+    d = hashlib.blake2b(key, digest_size=16).digest()
+    h1 = int.from_bytes(d[:8], "little")
+    h2 = int.from_bytes(d[8:], "little") | 1
+    return ((h1 + i * h2) % nbits for i in range(FILTER_PROBES))
+
+
+def build_filter(keys, count: int) -> bytes:
+    # multiple of 8 so the query side's len*8 equals the build-side modulus
+    nbits = (max(64, count * FILTER_BITS_PER_KEY) + 7) // 8 * 8
+    bits = bytearray(nbits // 8)
+    for key in keys:
+        for p in _filter_probes(key, nbits):
+            bits[p >> 3] |= 1 << (p & 7)
+    return bytes(bits)
+
+
+def filter_may_contain(filt: bytes, key: bytes) -> bool:
+    nbits = len(filt) * 8
+    if nbits == 0:
+        return True
+    return all(
+        filt[p >> 3] & (1 << (p & 7)) for p in _filter_probes(key, nbits)
+    )
 
 
 @dataclasses.dataclass
@@ -43,6 +79,7 @@ class TableInfo:
     key_min: bytes
     key_max: bytes
     entry_count: int
+    filter_address: int = 0  # 0 = no filter (pre-filter manifests)
 
     def to_json(self):
         return {
@@ -50,6 +87,7 @@ class TableInfo:
             "key_min": self.key_min.hex(),
             "key_max": self.key_max.hex(),
             "entry_count": self.entry_count,
+            "filter_address": self.filter_address,
         }
 
     @staticmethod
@@ -59,6 +97,7 @@ class TableInfo:
             key_min=bytes.fromhex(d["key_min"]),
             key_max=bytes.fromhex(d["key_max"]),
             entry_count=d["entry_count"],
+            filter_address=d.get("filter_address", 0),
         )
 
 
@@ -185,6 +224,14 @@ class Tree:
         return out
 
     def _table_get(self, info: TableInfo, key: bytes) -> bytes | None:
+        if info.filter_address:
+            # bloom check first: a negative skips the index+data reads
+            # entirely (reference: src/lsm/bloom_filter.zig consulted in
+            # lookup_from_levels_storage)
+            if not filter_may_contain(
+                self.grid.read_block(info.filter_address), key
+            ):
+                return None
         index = self.grid.read_block(info.index_address)
         # index payload: [addr u64][first_key key_size] per data block
         rec = 8 + self.key_size
@@ -238,10 +285,14 @@ class Tree:
             addr = self.grid.create_block(payload)
             index += addr.to_bytes(8, "little") + chunk[0][0]
         index_address = self.grid.create_block(bytes(index))
+        filter_address = self.grid.create_block(
+            build_filter((k for k, _ in items), len(items))
+        )
         return TableInfo(
             index_address=index_address,
             key_min=items[0][0], key_max=items[-1][0],
             entry_count=len(items),
+            filter_address=filter_address,
         )
 
     def _level_budget(self, level: int) -> int:
@@ -261,8 +312,11 @@ class Tree:
 
     def _compact_one(self, level: int) -> None:
         """Merge ONE victim table from `level` with the intersecting tables
-        of `level+1`: k-way newest-wins dedup, output split into bounded
-        disjoint tables, tombstone GC at the bottom."""
+        of `level+1`: a STREAMING two-way merge, block-at-a-time, with
+        bounded buffers — host memory stays O(block + output table), never
+        O(level) (reference: src/lsm/compaction.zig:1-32 streams via
+        iterators over grid blocks). Newest-wins dedup (the victim is one
+        level above, hence strictly newer); tombstone GC at the bottom."""
         if level + 1 >= len(self.levels):
             self.levels.append([])
         src, dst = self.levels[level], self.levels[level + 1]
@@ -279,33 +333,31 @@ class Tree:
         hi_i = lo_i
         while hi_i < len(dst) and dst[hi_i].key_min <= victim.key_max:
             hi_i += 1
-        merged: dict[bytes, bytes] = {}
-        for info in dst[lo_i:hi_i]:  # older data first, victim overwrites
-            merged.update(self._read_table(info))
-            self.grid_release_table(info)
-            self._log("r", level + 1, info)
-        merged.update(self._read_table(victim))
-        self.grid_release_table(victim)
-        self._log("r", level, victim)
+        olds = dst[lo_i:hi_i]
         bottom = (
             level + 1 == len(self.levels) - 1
             or all(not lvl for lvl in self.levels[level + 2 :])
         )
-        items = sorted(
-            (k, v)
-            for k, v in merged.items()
-            if not (bottom and v == self.tombstone)  # tombstone GC
+
+        def old_stream():  # disjoint + sorted: concatenation is sorted
+            for info in olds:
+                yield from self._iter_table(info)
+
+        out = self._write_merged(
+            self._iter_table(victim), old_stream(), drop_tombstones=bottom
         )
-        out = [
-            self._write_table(items[i : i + self.table_entries_max])
-            for i in range(0, len(items), self.table_entries_max)
-        ]
+        for info in olds:
+            self.grid_release_table(info)
+            self._log("r", level + 1, info)
+        self.grid_release_table(victim)
+        self._log("r", level, victim)
         for info in out:
             self._log("i", level + 1, info)
         self.levels[level + 1] = dst[:lo_i] + out + dst[hi_i:]
 
-    def _read_table(self, info: TableInfo) -> dict[bytes, bytes]:
-        out: dict[bytes, bytes] = {}
+    def _iter_table(self, info: TableInfo):
+        """Stream a table's (key, value) pairs, one data block resident at
+        a time."""
         index = self.grid.read_block(info.index_address)
         rec = 8 + self.key_size
         e = self.entry_size
@@ -313,9 +365,42 @@ class Tree:
             addr = int.from_bytes(index[i * rec : i * rec + 8], "little")
             data = self.grid.read_block(addr)
             for j in range(len(data) // e):
-                out[data[j * e : j * e + self.key_size]] = \
-                    data[j * e + self.key_size : (j + 1) * e]
-        return out
+                yield (
+                    data[j * e : j * e + self.key_size],
+                    data[j * e + self.key_size : (j + 1) * e],
+                )
+
+    _SENTINEL = (None, None)
+
+    def _write_merged(self, new_iter, old_iter, drop_tombstones: bool):
+        """Two-way streaming merge (new wins on equal keys) into bounded
+        output tables. Peak host memory: one input block per stream (grid
+        cache) + one output table's items."""
+        out_tables: list[TableInfo] = []
+        items: list[tuple[bytes, bytes]] = []
+
+        def emit(k, v):
+            if drop_tombstones and v == self.tombstone:
+                return
+            items.append((k, v))
+            if len(items) >= self.table_entries_max:
+                out_tables.append(self._write_table(items))
+                items.clear()
+
+        nk, nv = next(new_iter, self._SENTINEL)
+        ok, ov = next(old_iter, self._SENTINEL)
+        while nk is not None or ok is not None:
+            if ok is None or (nk is not None and nk <= ok):
+                if nk == ok:  # superseded old entry: drop it
+                    ok, ov = next(old_iter, self._SENTINEL)
+                emit(nk, nv)
+                nk, nv = next(new_iter, self._SENTINEL)
+            else:
+                emit(ok, ov)
+                ok, ov = next(old_iter, self._SENTINEL)
+        if items:
+            out_tables.append(self._write_table(items))
+        return out_tables
 
     def grid_release_table(self, info: TableInfo) -> None:
         index = self.grid.read_block(info.index_address)
@@ -323,6 +408,8 @@ class Tree:
         for i in range(len(index) // rec):
             self.grid.release(int.from_bytes(index[i * rec : i * rec + 8], "little"))
         self.grid.release(info.index_address)
+        if info.filter_address:
+            self.grid.release(info.filter_address)
 
     # -- checkpoint (persisted via the ManifestLog, lsm/manifest_log.py) --
 

@@ -148,6 +148,34 @@ class StateMachine:
 
     # -- commit: wire body in, wire reply out --
 
+    def commit_async(self, operation: Operation, timestamp: int, body: bytes):
+        """Dispatch a commit WITHOUT materializing results (the device
+        launch is queued; results stay on device). Returns a handle for
+        commit_finish. Only create ops are truly asynchronous; lookups are
+        reads and compute their reply inline (the handle is the bytes).
+        This is the replica's commit-stage overlap seam (reference:
+        src/vsr/replica.zig:3045-3103 commit_dispatch stages)."""
+        if operation not in _EVENT_DTYPES or not hasattr(
+            self.backend, "execute_async"
+        ):
+            return self.commit(operation, timestamp, body)  # reads / oracle
+        events = (
+            decode_accounts(body)
+            if operation == Operation.create_accounts
+            else decode_transfers(body)
+        )
+        return (operation, self.backend.execute_async(operation, timestamp, events))
+
+    def commit_finish(self, handle) -> bytes:
+        """Materialize a commit_async handle into the reply body bytes."""
+        if isinstance(handle, bytes):
+            return handle
+        operation, pending = handle
+        dense = self.backend.drain(pending)
+        return encode_results(
+            [(i, c) for i, c in enumerate(dense) if c], operation
+        )
+
     def commit(self, operation: Operation, timestamp: int, body: bytes) -> bytes:
         if operation == Operation.create_accounts:
             events = decode_accounts(body)

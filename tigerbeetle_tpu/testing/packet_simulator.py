@@ -15,6 +15,9 @@ import random
 from tigerbeetle_tpu.io.network import Address, Handler, Network
 
 
+PARTITION_MODES = ("uniform_size", "isolate_single", "single_link")
+
+
 class PacketSimulatorOptions:
     def __init__(
         self,
@@ -24,6 +27,8 @@ class PacketSimulatorOptions:
         packet_replay_probability: float = 0.0,
         partition_probability: float = 0.0,  # per tick: start a partition
         unpartition_probability: float = 0.2,  # per tick: heal it
+        partition_modes: tuple = PARTITION_MODES,
+        partition_symmetry_probability: float = 0.7,  # else one-way cut
     ):
         self.one_way_delay_min = one_way_delay_min
         self.one_way_delay_max = one_way_delay_max
@@ -31,6 +36,8 @@ class PacketSimulatorOptions:
         self.packet_replay_probability = packet_replay_probability
         self.partition_probability = partition_probability
         self.unpartition_probability = unpartition_probability
+        self.partition_modes = partition_modes
+        self.partition_symmetry_probability = partition_symmetry_probability
 
 
 class PacketSimulator(Network):
@@ -46,6 +53,10 @@ class PacketSimulator(Network):
         # partition: a set of replicas isolated from the rest (clients count
         # as being on the majority side)
         self.partition: set[int] = set()
+        # one-way cut replica links (src, dst) — the generalized form the
+        # reference's 5 partition modes/symmetries reduce to (reference:
+        # src/testing/packet_simulator.zig:79)
+        self.partition_links: set[tuple[int, int]] = set()
         self.crashed: set[int] = set()
         self.stats = {"sent": 0, "delivered": 0, "lost": 0, "replayed": 0,
                       "partitioned_drops": 0}
@@ -61,21 +72,52 @@ class PacketSimulator(Network):
     def _cut(self, src: Address, dst: Address) -> bool:
         if src in self.crashed or dst in self.crashed:
             return True
-        if not self.partition:
-            return False
-        a = src in self.partition if self._is_replica(src) else False
-        b = dst in self.partition if self._is_replica(dst) else False
-        return a != b  # across the partition boundary
+        if self.partition:
+            a = src in self.partition if self._is_replica(src) else False
+            b = dst in self.partition if self._is_replica(dst) else False
+            if a != b:  # across the partition boundary
+                return True
+        if self.partition_links and self._is_replica(src) and self._is_replica(dst):
+            return (src, dst) in self.partition_links
+        return False
+
+    def clear_partitions(self) -> None:
+        self.partition = set()
+        self.partition_links = set()
 
     def step_partitions(self) -> None:
         o = self.options
-        if self.partition:
+        if self.partition or self.partition_links:
             if self.rng.random() < o.unpartition_probability:
-                self.partition = set()
-        elif o.partition_probability > 0 and self.rng.random() < o.partition_probability:
-            # isolate a random minority of replicas
-            k = self.rng.randint(1, (self.replica_count - 1) // 2)
-            self.partition = set(self.rng.sample(range(self.replica_count), k))
+                self.clear_partitions()
+            return
+        if not (o.partition_probability > 0
+                and self.rng.random() < o.partition_probability):
+            return
+        mode = self.rng.choice(list(o.partition_modes))
+        symmetric = self.rng.random() < o.partition_symmetry_probability
+        n = self.replica_count
+        if mode == "isolate_single":
+            side = {self.rng.randrange(n)}
+        elif mode == "single_link":
+            a, b = self.rng.sample(range(n), 2)
+            self.partition_links.add((a, b))
+            if symmetric:
+                self.partition_links.add((b, a))
+            return
+        else:  # uniform_size: a random minority
+            k = self.rng.randint(1, max(1, (n - 1) // 2))
+            side = set(self.rng.sample(range(n), k))
+        if symmetric:
+            self.partition = side
+            return
+        # asymmetric: the side can send OUT but hears nothing back
+        for s in range(n):
+            for d in range(n):
+                if s == d:
+                    continue
+                if (s not in side) and (d in side):
+                    self.partition_links.add((s, d))
 
     # -- transport --
 

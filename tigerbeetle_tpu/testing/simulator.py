@@ -105,6 +105,9 @@ class Simulator:
         crash_probability: float = 0.002,
         restart_ticks_max: int = 80,
         wal_fault_probability: float = 0.2,
+        torn_write_probability: float = 0.2,
+        replies_fault_probability: float = 0.1,
+        superblock_fault_probability: float = 0.1,
         options: PacketSimulatorOptions | None = None,
         backend_factory=OracleStateMachine,
         process=None,
@@ -119,6 +122,9 @@ class Simulator:
         self.crash_probability = crash_probability
         self.restart_ticks_max = restart_ticks_max
         self.wal_fault_probability = wal_fault_probability
+        self.torn_write_probability = torn_write_probability
+        self.replies_fault_probability = replies_fault_probability
+        self.superblock_fault_probability = superblock_fault_probability
         self.backend_factory = backend_factory
         self.replica_count = replica_count
 
@@ -150,6 +156,9 @@ class Simulator:
         self.down: dict[int, int] = {}  # replica -> restart tick
         self.crashes = 0
         self.wal_faults = 0
+        self.torn_writes = 0
+        self.replies_faults = 0
+        self.superblock_faults = 0
 
         self.clients = [
             SimClient(
@@ -193,25 +202,82 @@ class Simulator:
         ):
             victim = self.rng.choice(alive)
             self.crashes += 1
-            # NOTE: no torn writes here. The replica acks only after its
-            # O_DSYNC write returned, so an acknowledged write is durable by
-            # contract; a write truly cut by power loss was never acked and
-            # never observed by this synchronous code. (Tolerating loss of
-            # ACKED writes needs the reference's protocol-aware-recovery
-            # nack quorums — not implemented.)
+            if self.rng.random() < self.torn_write_probability:
+                self._inject_torn_head(victim)
             self.net.crashed.add(victim)
             self.down[victim] = now + self.rng.randint(
                 10, self.restart_ticks_max
             )
+
+    def _inject_torn_head(self, i: int) -> None:
+        """Crash-point torn write: the victim's most recent journal write
+        is cut mid-sector, modeling a crash DURING write_prepare
+        (reference: src/simulator.zig:160-173 crash-point faults). Tears
+        either the prepare body only (redundant header survives -> TORN
+        slot, body repairable from any acker) or both rings (-> BLANK
+        slot, an explicit nack in protocol-aware recovery).
+
+        Fault atlas rule (reference: src/testing/storage.zig:1-25): only
+        tear when at least one OTHER replica journaled the op, so a copy
+        survives cluster-wide and a possibly-acked op cannot vanish."""
+        victim = self.replicas[i]
+        op = victim.op
+        if op < 1 or victim.journal.read_prepare(op) is None:
+            return
+        survivors = any(
+            self.replicas[j].journal.read_prepare(op) is not None
+            for j in range(self.replica_count)
+            if j != i
+        )
+        if not survivors:
+            return
+        cfg = self.cluster_config
+        slot = victim.journal.slot_for_op(op)
+        self.storages[i].fault(
+            Zone.wal_prepares, slot * cfg.message_size_max + 160, 96
+        )
+        if self.rng.random() < 0.5:  # tear the redundant header too: BLANK
+            self.storages[i].fault(Zone.wal_headers, slot * 128, 128)
+        self.torn_writes += 1
 
     def _maybe_restart(self, now: int) -> None:
         for i, when in list(self.down.items()):
             if now >= when:
                 if self.rng.random() < self.wal_fault_probability:
                     self._inject_wal_fault(i)
+                if self.rng.random() < self.replies_fault_probability:
+                    self._inject_replies_fault(i)
+                if self.rng.random() < self.superblock_fault_probability:
+                    self._inject_superblock_fault(i)
                 del self.down[i]
                 self.net.crashed.discard(i)
                 self.replicas[i] = self._make_replica(i)
+
+    def _inject_replies_fault(self, i: int) -> None:
+        """Corrupt one client_replies slot: the checksum-validated restore
+        must read it as absent and fall back to the reply-lost paths
+        (reference: src/testing/storage.zig faults every zone)."""
+        slot = self.rng.randrange(self.cluster_config.clients_max)
+        self.storages[i].fault(
+            Zone.client_replies,
+            slot * self.cluster_config.message_size_max
+            + self.rng.randrange(0, 256),
+            64,
+        )
+        self.replies_faults += 1
+
+    def _inject_superblock_fault(self, i: int) -> None:
+        """Corrupt ONE of the superblock's redundant copies: the quorum
+        (4 copies) must still open. Atlas rule: never more than one copy
+        per restart (a lost quorum is a beyond-f fault)."""
+        copy = self.rng.randrange(ZoneLayout.SUPERBLOCK_COPIES)
+        self.storages[i].fault(
+            Zone.superblock,
+            copy * ZoneLayout.SUPERBLOCK_COPY_SIZE
+            + self.rng.randrange(0, 1024),
+            64,
+        )
+        self.superblock_faults += 1
 
     def _inject_wal_fault(self, i: int) -> None:
         """Corrupt one WAL prepare body on the restarting replica — the
@@ -274,12 +340,15 @@ class Simulator:
             "replies": sum(c.replies for c in self.clients),
             "crashes": self.crashes,
             "wal_faults": self.wal_faults,
+            "torn_writes": self.torn_writes,
+            "replies_faults": self.replies_faults,
+            "superblock_faults": self.superblock_faults,
             "net": dict(self.net.stats),
             "view": self.replicas[0].view,
         }
 
     def _heal_and_converge(self) -> None:
-        self.net.partition = set()
+        self.net.clear_partitions()
         self.net.options.partition_probability = 0.0
         self.net.options.packet_loss_probability = 0.0
         self.crash_probability = 0.0

@@ -61,6 +61,46 @@ class Journal:
             bytes(self._headers[sector : sector + SECTOR_SIZE]),
         )
 
+    def invalidate_above(self, op_max: int) -> None:
+        """Destroy journal evidence for every op above `op_max` — BOTH the
+        header-mirror/redundant ring and the prepare ring.
+
+        Called when a view change completes: the quorum decided the log
+        ends at `op_max`, so any surviving slot above it holds a superseded
+        prepare from an abandoned view. Left in place, the next
+        _dvc_suffix_headers scan would re-advertise those headers under
+        this replica's NEW log_view, where best-log merging treats them as
+        authoritative — a truncated prepare could be resurrected and shadow
+        the op committed in the intervening view (replica divergence). The
+        disk writes make the invalidation survive a restart (recover()
+        would otherwise rebuild the mirror from the stale rings)."""
+        for slot in range(self.slot_count):
+            off = slot * HEADER_SIZE
+            h = Header.from_bytes(bytes(self._headers[off : off + HEADER_SIZE]))
+            if not (h.valid_checksum() and h.command == Command.prepare):
+                continue
+            if h.op <= op_max:
+                continue
+            self._headers[off : off + HEADER_SIZE] = bytes(HEADER_SIZE)
+            sector = off // SECTOR_SIZE * SECTOR_SIZE
+            self.storage.write(
+                Zone.wal_headers, sector,
+                bytes(self._headers[sector : sector + SECTOR_SIZE]),
+            )
+            # Tear the prepare's own header sector too: recover() must not
+            # resurrect the slot from the prepare ring.
+            praw = self.storage.read(
+                Zone.wal_prepares, slot * self.msg_max, HEADER_SIZE
+            )
+            p = Header.from_bytes(praw[:HEADER_SIZE])
+            if p.valid_checksum() and p.command == Command.prepare and p.op > op_max:
+                self.storage.write(
+                    Zone.wal_prepares, slot * self.msg_max, bytes(SECTOR_SIZE)
+                )
+            if getattr(self, "faulty", None):
+                if self.faulty.get(slot, 0) > op_max:
+                    del self.faulty[slot]
+
     def get_header(self, op: int) -> Header | None:
         """The op's header from the in-memory redundant-header mirror (valid
         for faulty slots too — that is the point of the redundant ring)."""

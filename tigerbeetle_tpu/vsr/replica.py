@@ -35,11 +35,13 @@ simulator run this exact code.
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 
 from tigerbeetle_tpu.constants import ConfigCluster, ConfigProcess
 from tigerbeetle_tpu.io.network import Network
 from tigerbeetle_tpu.io.storage import Storage
 from tigerbeetle_tpu.io.time import Time
+from tigerbeetle_tpu.lsm.grid import GridBlockCorrupt
 from tigerbeetle_tpu.models.ledger import DeviceLedger
 from tigerbeetle_tpu.state_machine import StateMachine
 from tigerbeetle_tpu.types import Operation
@@ -61,6 +63,8 @@ HEARTBEAT_TICKS = 4  # primary: commit heartbeat cadence
 PING_TICKS = 8  # clock sync cadence
 VIEW_CHANGE_TICKS = 40  # backup: silence before starting a view change
 RETRY_TICKS = 16  # view-change message retry cadence
+GRID_SCRUB_TICKS = 8  # forest-block scrub cadence (reference: grid scrubber)
+GRID_SCRUB_BLOCKS = 8  # acquired blocks verified per scrub pass
 
 # DVC suffix NACK marker: a synthetic header whose `operation` proves the
 # sender's slot for that op is BLANK — it never prepared the op (the
@@ -134,6 +138,23 @@ class Replica:
 
         # repair state: ops whose prepares we asked peers for
         self._repair_wanted: set[int] = set()
+        # last tick we asked a peer for a full checkpoint (rate limit)
+        self._sync_request_tick = -RETRY_TICKS
+        # Commit-stage overlap (reference: src/vsr/replica.zig:52-70
+        # CommitStage; :3045-3103 commit_dispatch): with commit_window > 0,
+        # device commits are DISPATCHED asynchronously (JAX async dispatch
+        # — the launch is queued, the host returns immediately) and their
+        # results drained later, so the journal write + broadcast of op N+1
+        # overlap the device execution of op N. 0 = fully synchronous
+        # (deterministic tests). The event loop calls flush_commits() when
+        # idle; state-changing transitions (checkpoint, view change, state
+        # sync) flush first.
+        self.commit_window = 0
+        self._inflight: deque[dict] = deque()
+        # grid repair state: forest-block addresses awaiting peer repair
+        # (reference: src/vsr/grid_blocks_missing.zig)
+        self._grid_missing: set[int] = set()
+        self._scrub_cursor = 0
         # test/simulator observation hook: called on every committed prepare
         self.commit_hook = None
         # optional append-only disaster-recovery log (reference: src/aof.zig,
@@ -240,6 +261,7 @@ class Replica:
         ops beyond it stay replayable in the WAL). The replicated client
         table rides in the snapshot meta — it is part of the replicated
         state (reference: src/vsr/superblock.zig ClientSessions trailer)."""
+        self.flush_commits()  # snapshot sees finalized client-table state
         table = {
             str(c): {
                 "session": e["session"],
@@ -277,6 +299,7 @@ class Replica:
 
     def tick(self) -> None:
         self.ticks += 1
+        self.flush_commits()  # bound reply latency to one tick worst-case
         if self.status == "normal":
             if self.is_primary:
                 if self.ticks % HEARTBEAT_TICKS == 0:
@@ -299,6 +322,21 @@ class Replica:
             if self.ticks % PING_TICKS == 0:
                 ping = Header(command=int(Command.ping), op=self.time.monotonic())
                 self._broadcast(ping)
+            if (
+                self.forest is not None
+                and self.replica_count > 1
+                and self.ticks % GRID_SCRUB_TICKS == 0
+            ):
+                self._scrub_grid()
+            if self._grid_missing and self.ticks % RETRY_TICKS == 0:
+                self._request_block_repair(())  # retransmit lost requests
+            if (
+                getattr(self, "_sync_payload_cache", None) is not None
+                and self.ticks - self._sync_payload_tick > 4 * RETRY_TICKS
+            ):
+                # the full checkpoint image (tens of MiB) must not stay
+                # pinned after the lagging replica finished its transfer
+                self._sync_payload_cache = None
         elif self.status == "recovering":
             if self.ticks - self._recover_tick > VIEW_CHANGE_TICKS:
                 # Nobody sent a start_view (the cluster may lack a primary):
@@ -365,6 +403,12 @@ class Replica:
             return
         if cmd == Command.request_prepare:
             self._on_request_prepare(header)
+            return
+        if cmd == Command.request_blocks:
+            self._on_request_blocks(header, body)
+            return
+        if cmd == Command.block:
+            self._on_block(header, body)
             return
         if cmd == Command.request_sync_manifest:  # request full checkpoint
             self._on_request_sync_checkpoint(header)
@@ -487,6 +531,8 @@ class Replica:
         # Retransmission of a request still awaiting quorum: already in
         # the pipeline — preparing it again would execute it twice
         # (reference: pipeline_prepare_queue message_by_client check).
+        # Dispatched-but-unfinalized commits (async window) are equally
+        # in flight: the client table only learns the request at finalize.
         for entry_p in self.pipeline.values():
             h = entry_p["header"]
             if (
@@ -494,6 +540,10 @@ class Replica:
                 and h.request == header.request
                 and h.operation == header.operation
             ):
+                return
+        for entry_i in self._inflight:
+            h = entry_i["header"]
+            if h.client == client and h.request == header.request:
                 return
 
         # Pipeline backpressure (reference: pipeline_prepare_queue_max=8):
@@ -659,15 +709,107 @@ class Replica:
         )
 
     # ------------------------------------------------------------------
+    # grid block repair: a corrupt forest block heals from any peer that
+    # holds an intact copy — no full state sync needed (reference:
+    # src/vsr/grid_blocks_missing.zig + src/vsr/grid.zig:731). Detection
+    # is (a) a periodic scrub pass over acquired blocks and (b) lazy, at
+    # the read that trips GridBlockCorrupt in the commit path (which then
+    # stalls that op and retries once the block is healed).
+    # ------------------------------------------------------------------
+
+    def _request_block_repair(self, addresses) -> bool:
+        """Record missing blocks and ask ONE peer (rotating on retries —
+        broadcasting would draw (n-1) duplicate 128 KiB replies per block;
+        the reference's grid_blocks_missing requests from one replica at a
+        time too). Returns False when repair is impossible (no forest /
+        single replica) — the caller should treat corruption as fatal."""
+        if self.forest is None or self.replica_count == 1:
+            return False
+        self._grid_missing.update(addresses)
+        body = b"".join(
+            a.to_bytes(8, "little") for a in sorted(self._grid_missing)
+        )
+        self._repair_peer_rotation = getattr(self, "_repair_peer_rotation", 0) + 1
+        # 1 + (rot mod n-1) ∈ [1, n-1], so the offset never lands on self
+        peer = (
+            self.replica + 1 + (self._repair_peer_rotation % (self.replica_count - 1))
+        ) % self.replica_count
+        rq = Header(command=int(Command.request_blocks))
+        self._send(peer, rq, body)
+        return True
+
+    def _on_request_blocks(self, header: Header, body: bytes) -> None:
+        if self.forest is None:
+            return
+        grid = self.forest.grid
+        for i in range(0, len(body), 8):
+            a = int.from_bytes(body[i : i + 8], "little")
+            if not (1 <= a <= grid.block_count):
+                continue
+            raw = grid.read_block_raw(a)  # verified: never spread corruption
+            if raw is None:
+                continue
+            reply = Header(command=int(Command.block), op=a)
+            self._send(header.replica, reply, raw)
+
+    def _on_block(self, header: Header, body: bytes) -> None:
+        if self.forest is None or header.op not in self._grid_missing:
+            return
+        grid = self.forest.grid
+        # A late duplicate reply must not overwrite an address that has
+        # healed and since been released + reused — the stale bytes carry
+        # a valid checksum, so the clobber would be silent.
+        if grid.free_set.is_free(header.op) or grid.verify_block(header.op):
+            self._grid_missing.discard(header.op)
+        elif grid.install_block_raw(header.op, body):
+            self._grid_missing.discard(header.op)
+        else:
+            return  # corrupt in flight: the tick retry re-requests
+        if not self._grid_missing and self.status == "normal":
+            # healed: retry whatever stalled on the corrupt block
+            if self.is_primary:
+                self._maybe_commit_pipeline()
+            else:
+                self._commit_up_to(self.commit_max)
+
+    def _scrub_grid(self) -> None:
+        """Verify a few acquired forest blocks per pass, round-robin
+        (the reference's grid scrubber): corruption below the WAL is found
+        and repaired from peers BEFORE a commit needs the block."""
+        grid = self.forest.grid
+        checked = scanned = 0
+        a = self._scrub_cursor
+        n = grid.block_count
+        corrupt = []
+        while checked < GRID_SCRUB_BLOCKS and scanned < n:
+            a = a % n + 1
+            scanned += 1
+            if grid.free_set.is_free(a):
+                continue
+            checked += 1
+            if not grid.verify_block(a):
+                corrupt.append(a)
+        self._scrub_cursor = a
+        if corrupt:
+            self._request_block_repair(corrupt)
+
+    # ------------------------------------------------------------------
     # state sync: checkpoint shipping for replicas lagging beyond the WAL
     # (reference: src/vsr/sync.zig — a lagging replica jumps to a newer
     # checkpoint, then repairs the remaining WAL tail normally)
     # ------------------------------------------------------------------
 
-    def _on_request_sync_checkpoint(self, header: Header) -> None:
+    def _sync_checkpoint_payload(self) -> tuple[bytes, int] | None:
+        """(full image, checksum) to ship: state + snapshot blobs +
+        (spill) forest blocks. Cached per superblock sequence — rebuilding
+        or re-hashing per chunk request would be O(image) each."""
         state = self.superblock.state
         if state is None or state.commit_min == 0:
-            return
+            return None
+        cached = getattr(self, "_sync_payload_cache", None)
+        if cached is not None and cached[0] == state.sequence:
+            self._sync_payload_tick = self.ticks
+            return cached[1], cached[2]
         from tigerbeetle_tpu.io.storage import Zone
 
         payload = state.to_bytes()
@@ -678,10 +820,7 @@ class Replica:
         # With a spill store, ship the forest's acquired grid blocks too:
         # the checkpoint's spill meta references them by address, and grid
         # addresses are layout-relative, so the receiver installs them at
-        # the same addresses in its own forest area. (Shipped in one body
-        # here; the reference ships trailers by bounded chunk —
-        # src/vsr/sync.zig — which is the production path once state
-        # exceeds one message.)
+        # the same addresses in its own forest area.
         forest_section = b""
         if getattr(self.ledger, "spill", None) is not None:
             from tigerbeetle_tpu.lsm.grid import BLOCK_SIZE
@@ -699,21 +838,55 @@ class Replica:
                 )
                 parts.append(a.to_bytes(8, "little") + raw)
             forest_section = b"".join(parts)
-        body = (
+        full = (
             len(payload).to_bytes(8, "little") + payload + blob_bytes
             + forest_section
         )
-        reply = Header(command=int(Command.sync_manifest))
-        self._send(header.replica, reply, body)
+        from tigerbeetle_tpu import native
+
+        checksum = native.checksum(full)  # hashed ONCE per image, not per chunk
+        self._sync_payload_cache = (state.sequence, full, checksum)
+        self._sync_payload_tick = self.ticks
+        return full, checksum
+
+    @property
+    def _sync_chunk_size(self) -> int:
+        return self.cluster.message_size_max - HEADER_SIZE
+
+    def _on_request_sync_checkpoint(self, header: Header) -> None:
+        """Serve ONE bounded chunk of the checkpoint image (reference:
+        src/vsr/sync.zig:9-56 — trailers ship in message-sized chunks, the
+        receiver requests them progressively). header.op = chunk index.
+        The reply carries commit=checkpoint op, timestamp=total size,
+        parent=checksum(full image) so the receiver can detect a source
+        checkpoint advancing mid-transfer and restart."""
+        got = self._sync_checkpoint_payload()
+        if got is None:
+            return
+        full, checksum = got
+        state = self.superblock.state
+        chunk_size = self._sync_chunk_size
+        index = header.op
+        if index * chunk_size >= len(full):
+            return  # out of range (stale request for a shrunken image)
+        chunk = full[index * chunk_size : (index + 1) * chunk_size]
+        reply = Header(
+            command=int(Command.sync_manifest),
+            op=index,
+            commit=state.commit_min,
+            timestamp=len(full),
+            parent=checksum,
+        )
+        self._send(header.replica, reply, chunk)
 
     def _on_sync_checkpoint(self, header: Header, body: bytes) -> None:
-        """Adopt a peer's checkpoint wholesale (we are too far behind for
-        WAL repair). Only while adopting a log whose base our WAL cannot
-        reach."""
-        from tigerbeetle_tpu import native
-        from tigerbeetle_tpu.io.storage import Zone
-        from tigerbeetle_tpu.vsr.superblock import BlobRef, VSRState
-
+        """One CHUNK of a peer's checkpoint image. Gather until complete
+        (requesting the next missing chunk each arrival — the transfer is
+        self-clocking), verify the whole-image checksum, then install.
+        A source whose checkpoint advanced mid-transfer changes the image
+        checksum (header.parent): the gather restarts on the new image
+        (reference: src/vsr/sync.zig stage machine with restart-on-
+        target-change)."""
         adopting = (
             self.status in ("view_change", "recovering")
             and self._adopt is not None
@@ -724,6 +897,45 @@ class Replica:
         # replaces a committed prefix with a longer committed prefix.
         if not adopting and self.status != "normal":
             return
+        if header.commit <= self.commit_min:
+            return  # stale / not an improvement
+        from tigerbeetle_tpu import native
+
+        key = (header.parent, header.commit, header.timestamp)
+        gather = getattr(self, "_sync_gather", None)
+        if gather is None or gather["key"] != key:
+            gather = {"key": key, "chunks": {}, "total": header.timestamp}
+            self._sync_gather = gather
+        gather["chunks"][header.op] = body
+        chunk_size = self._sync_chunk_size
+        n_chunks = (gather["total"] + chunk_size - 1) // chunk_size
+        missing = next(
+            (i for i in range(n_chunks) if i not in gather["chunks"]), None
+        )
+        if missing is not None:
+            rq = Header(
+                command=int(Command.request_sync_manifest), op=missing
+            )
+            self._send(header.replica, rq)
+            return
+        full = b"".join(gather["chunks"][i] for i in range(n_chunks))
+        self._sync_gather = None
+        if len(full) != gather["total"] or native.checksum(full) != header.parent:
+            return  # torn/mixed image: the tick-cadence retry restarts
+        self._install_sync_checkpoint(full)
+
+    def _install_sync_checkpoint(self, body: bytes) -> None:
+        """Adopt a peer's complete checkpoint image (we are too far behind
+        for WAL repair)."""
+        from tigerbeetle_tpu import native
+        from tigerbeetle_tpu.io.storage import Zone
+        from tigerbeetle_tpu.vsr.superblock import BlobRef, VSRState
+
+        adopting = (
+            self.status in ("view_change", "recovering")
+            and self._adopt is not None
+        )
+        self.flush_commits()  # restore replaces the ledger state wholesale
         n = int.from_bytes(body[:8], "little")
         remote = VSRState.from_bytes(body[8 : 8 + n])
         if remote.commit_min <= self.commit_min:
@@ -757,11 +969,25 @@ class Replica:
             fo = self.storage.layout.forest_offset
             count = int.from_bytes(blob_raw[pos : pos + 4], "little")
             pos += 4
+            blocks: list[tuple[int, bytes]] = []
             for _ in range(count):
                 a = int.from_bytes(blob_raw[pos : pos + 8], "little")
                 pos += 8
                 raw = blob_raw[pos : pos + BLOCK_SIZE]
                 pos += BLOCK_SIZE
+                # Verify the block's embedded checksum BEFORE any install
+                # (the blob path above does the same): a corrupt-in-flight
+                # block adopted here would only surface later as a
+                # read_block error mid-commit, with no refetch path. All
+                # blocks verify before any write so a rejected checkpoint
+                # never leaves the forest area half-replaced (addresses
+                # are shared with the CURRENT checkpoint's references).
+                from tigerbeetle_tpu.lsm.grid import Grid
+
+                if Grid.validate_raw(raw) is None:
+                    return  # corrupt in flight: retry will refetch
+                blocks.append((a, raw))
+            for a, raw in blocks:
                 self.storage.write(Zone.grid, fo + (a - 1) * BLOCK_SIZE, raw)
             self.ledger.spill.forest.grid.cache.clear()
         self.storage.sync()
@@ -824,12 +1050,27 @@ class Replica:
             if entry is None or len(entry["oks"]) < self.quorum_replication:
                 break
             header, body = entry["header"], entry["body"]
-            reply_wire = self._commit_prepare(header, body)
+            try:
+                if self.commit_window > 0:
+                    # overlapped: dispatch now, drain/reply on flush — the
+                    # next request's journal write + broadcast run while
+                    # the device executes this batch
+                    self._inflight.append(self._commit_dispatch(header, body))
+                    self.flush_commits(keep=self.commit_window)
+                else:
+                    reply_wire = self._commit_prepare(header, body)
+                    if reply_wire is not None:
+                        self.network.send(
+                            self.replica, header.client, reply_wire
+                        )
+            except GridBlockCorrupt as e:
+                # stall this op; retry when the block heals (_on_block)
+                if not self._request_block_repair([e.address]):
+                    raise  # single replica / no forest: unrecoverable
+                break
             self.commit_min = self.commit_max = op
             self.commit_checksum = header.checksum
             del self.pipeline[op]
-            if reply_wire is not None:
-                self.network.send(self.replica, header.client, reply_wire)
             committed = True
         if committed:
             # commit heartbeat so backups commit promptly (also sent on a
@@ -854,7 +1095,13 @@ class Replica:
             self.commit_max - self.commit_min
             >= self.cluster.checkpoint_interval
             and not self.is_primary
+            # Rate limit: every commit heartbeat lands here while we lag,
+            # and each request is answered with the FULL checkpoint —
+            # unbounded amplification without a tick-cadence guard
+            # (reference: sync requests ride timeouts, not messages).
+            and self.ticks - self._sync_request_tick >= RETRY_TICKS
         ):
+            self._sync_request_tick = self.ticks
             rq = Header(command=int(Command.request_sync_manifest))
             self._send(self.primary_index, rq)
             # fall through to WAL repair as well: at the boundary the
@@ -875,7 +1122,17 @@ class Replica:
                 self._request_prepare(op, self.primary_index)
                 return
             header, body = got
-            self._commit_prepare(header, body)
+            try:
+                if self.commit_window > 0:
+                    self._inflight.append(self._commit_dispatch(header, body))
+                    self.flush_commits(keep=self.commit_window)
+                else:
+                    self._commit_prepare(header, body)
+            except GridBlockCorrupt as e:
+                # stall; retry when the block heals (_on_block)
+                if not self._request_block_repair([e.address]):
+                    raise
+                return
             self.commit_min = op
             self.commit_checksum = header.checksum
             self.pipeline.pop(op, None)  # prune if it was pipelined
@@ -887,12 +1144,38 @@ class Replica:
         (reference: src/vsr/client_replies.zig — replies are replicated so
         a post-view-change primary can answer duplicate requests); only the
         primary actually sends it. Returns the reply wire bytes."""
-        if self.commit_hook is not None:
-            self.commit_hook(header, body)
-        if self.aof is not None:
-            self.aof.append(header, body)  # durable before the reply
+        return self._commit_finalize(self._commit_dispatch(header, body))
+
+    def _commit_dispatch(self, header: Header, body: bytes) -> dict:
+        """Stage 1: apply the prepare to the replicated state WITHOUT
+        materializing device results (JAX async dispatch — create-op
+        launches are queued and the host returns). Host-side effects that
+        must be ordered (AOF, commit hooks, register sessions, the
+        prepare-timestamp clamp) happen here, in op order. The
+        state-machine dispatch runs FIRST: it may raise GridBlockCorrupt
+        (spill reads), and the stall/retry path re-enters this method for
+        the same op — AOF records and commit hooks must not duplicate.
+        AOF still precedes the reply (sent at finalize)."""
         operation = Operation(header.operation)
+        handle = None
+        reply_body = None
         if operation == Operation.register:
+            # At clients_max, evict the OLDEST session (lowest session
+            # number — deterministic, so every replica evicts the same
+            # one) and tell that client (reference:
+            # src/vsr/replica.zig:3758-3860 + eviction command,
+            # src/vsr.zig:136). Its slot is then free for the newcomer.
+            if (
+                header.client not in self.client_table
+                and len(self.client_table) >= self.cluster.clients_max
+            ):
+                victim = min(
+                    self.client_table,
+                    key=lambda c: self.client_table[c]["session"],
+                )
+                del self.client_table[victim]
+                if self.is_primary:
+                    self._send_eviction(victim)
             used = {
                 e.get("slot") for e in self.client_table.values()
             } - {None}
@@ -904,17 +1187,33 @@ class Replica:
                 "session": header.op,
                 "request": 0,
                 "reply": None,
-                # reply-persistence slot (reference: client_replies.zig);
-                # None once clients_max sessions exist — that reply simply
-                # isn't persisted (the reference evicts instead)
+                # reply-persistence slot (reference: client_replies.zig)
                 "slot": free[0] if free else None,
             }
             reply_body = header.op.to_bytes(8, "little")  # session number
         else:
-            reply_body = self.sm.commit(operation, header.timestamp, body)
+            handle = self.sm.commit_async(operation, header.timestamp, body)
             self.sm.prepare_timestamp = max(
                 self.sm.prepare_timestamp, header.timestamp
             )
+        if self.commit_hook is not None:
+            self.commit_hook(header, body)
+        if self.aof is not None:
+            self.aof.append(header, body)  # durable before the reply
+        return {
+            "header": header,
+            "handle": handle,
+            "reply_body": reply_body,
+            "to_client": self.is_primary,
+        }
+
+    def _commit_finalize(self, entry: dict) -> bytes | None:
+        """Stage 2: materialize the results (drains the device batch),
+        build + store the reply, persist the client-replies slot."""
+        header = entry["header"]
+        reply_body = entry["reply_body"]
+        if reply_body is None:
+            reply_body = self.sm.commit_finish(entry["handle"])
         reply = Header(
             command=int(Command.reply),
             client=header.client,
@@ -930,16 +1229,27 @@ class Replica:
         reply.view = self.view
         reply.set_checksum()
         wire = reply.to_bytes() + reply_body
-        entry = self.client_table.get(header.client)
-        if entry is not None:
-            entry["request"] = header.request
-            entry["reply"] = wire
-            entry["reply_checksum"] = reply.checksum
-            if entry.get("slot") is not None:
+        tentry = self.client_table.get(header.client)
+        if tentry is not None:
+            tentry["request"] = header.request
+            tentry["reply"] = wire
+            tentry["reply_checksum"] = reply.checksum
+            if tentry.get("slot") is not None:
                 # persist so a post-restart primary can answer a duplicate
                 # with the ORIGINAL bytes (reference: client_replies.zig)
-                self.client_replies.write(entry["slot"], wire)
+                self.client_replies.write(tentry["slot"], wire)
         return wire
+
+    def flush_commits(self, keep: int = 0) -> None:
+        """Finalize queued async commits (oldest first) until at most
+        `keep` remain in flight. The event loop calls this when the bus has
+        no more incoming frames; _maybe_commit_pipeline calls it with
+        keep=commit_window to bound the window."""
+        while len(self._inflight) > keep:
+            entry = self._inflight.popleft()
+            wire = self._commit_finalize(entry)
+            if wire is not None and entry["to_client"]:
+                self.network.send(self.replica, entry["header"].client, wire)
 
     # ------------------------------------------------------------------
     # view change (reference: src/vsr/replica.zig:1595-1924)
@@ -949,6 +1259,7 @@ class Replica:
         assert new_view > self.view
         if self.status == "view_change" and new_view <= self.view_candidate:
             return
+        self.flush_commits()  # no async commits across a status change
         self.status = "view_change"
         self.view_candidate = new_view
         self._svc_votes = {self.replica}
@@ -985,16 +1296,25 @@ class Replica:
 
     def _suffix_headers(self) -> list[Header]:
         """Headers of ops (commit_min, op] — the log suffix an SV carries.
-        Only REAL headers: a normal-status primary has every suffix op
-        readable (adoption required the bodies before it could finish);
-        markers must never reach an SV — a backup would adopt one as a
-        real header and wedge waiting for a prepare whose checksum can
-        never match."""
+        Only REAL headers (never nack markers — a backup would adopt one as
+        a real header and wedge waiting for a prepare whose checksum can
+        never match). A suffix op whose BODY is torn (in-place media fault
+        after adoption verified it) still contributes its redundant-ring
+        header — authoritative evidence — and we repair the body from
+        backups rather than crashing (any acker can serve it; SV receivers
+        independently fetch bodies from every peer in _begin_adoption)."""
         out = []
         for op in range(self.commit_min + 1, self.op + 1):
             got = self.journal.read_prepare(op)
-            assert got is not None, f"SV suffix op {op} unreadable"
-            out.append(got[0])
+            if got is not None:
+                out.append(got[0])
+                continue
+            h = self.journal.get_header(op)
+            assert h is not None, f"SV suffix op {op}: no journal evidence"
+            out.append(h)
+            for r in range(self.replica_count):
+                if r != self.replica:
+                    self._request_prepare(op, r)
         return out
 
     def _dvc_suffix_headers(self) -> tuple[list[Header], int]:
@@ -1221,8 +1541,10 @@ class Replica:
             # guard bounds (src_op - src_checkpoint) within one ring, so
             # once we sync to its checkpoint every remaining fill fits
             # distinct slots.
-            rq = Header(command=int(Command.request_sync_manifest))
-            self._send(self._adopt_src, rq)
+            if self.ticks - self._sync_request_tick >= RETRY_TICKS:
+                self._sync_request_tick = self.ticks
+                rq = Header(command=int(Command.request_sync_manifest))
+                self._send(self._adopt_src, rq)
             return
         hi = min(self._adopt_base, self.op + self.CATCHUP_WINDOW)
         for o in range(self.op + 1, hi + 1):
@@ -1358,6 +1680,12 @@ class Replica:
         self._adopt = None
         self._dvc = {}
         self._repair_wanted.clear()
+        # The quorum decided the log ends at self.op: destroy journal
+        # evidence above it, or the next _dvc_suffix_headers scan would
+        # re-advertise superseded headers under our NEW log_view and a
+        # truncated prepare could shadow a committed op (see
+        # Journal.invalidate_above).
+        self.journal.invalidate_above(self.op)
         if primary:
             suffix = self._suffix_headers()
             sv = Header(
@@ -1402,6 +1730,7 @@ class Replica:
             Header.from_bytes(body[i : i + HEADER_SIZE])
             for i in range(0, len(body), HEADER_SIZE)
         ]
+        self.flush_commits()  # no async commits across a status change
         self.status = "view_change"
         self.view_candidate = header.view
         self.pipeline = {}
