@@ -288,6 +288,53 @@ def bench_tracked_configs(stage) -> dict:
             "mixed config must exercise the split executor"
         )
 
+    # 6. spill-active steady state: the transfer table's HBM budget is a
+    # fraction of the workload, so the cold tail spills to the LSM forest
+    # every few batches and the pre-commit reload path stays hot — the
+    # bounded-memory cliff, measured rather than assumed.
+    with stage("cfg_spill"):
+        from tigerbeetle_tpu.constants import TEST_CLUSTER
+        from tigerbeetle_tpu.io.storage import MemoryStorage, ZoneLayout
+        from tigerbeetle_tpu.lsm.grid import Grid
+        from tigerbeetle_tpu.lsm.groove import Forest
+
+        layout = ZoneLayout(TEST_CLUSTER, grid_size=256 * 1024 * 1024)
+        forest = Forest(Grid(
+            MemoryStorage(layout), offset=0, block_count=1792,
+            cache_blocks=128,
+        ))
+        process = ConfigProcess(account_slots_log2=16,
+                                transfer_slots_log2=16)  # 32k-row budget
+        ledger = DeviceLedger(process=process, mode="auto", forest=forest)
+        ledger.pad_to = BATCH_PAD
+        ts2 = 1 << 41
+        next_id = 1
+        while next_id <= N_ACCOUNTS:
+            k = min(BATCH, N_ACCOUNTS - next_id + 1)
+            ts2 += k
+            ledger.execute_async(
+                Operation.create_accounts, ts2, build_accounts(next_id, k)
+            )
+            next_id += k
+        n_sp = 0
+        nbatches = int(os.environ.get("BENCH_SPILL_BATCHES", 24))
+        warm = build_transfers(rng, 5_000_000, BATCH)
+        ts2 += BATCH
+        ledger.drain(ledger.execute_async(
+            Operation.create_transfers, ts2, warm
+        ))
+        t0 = time.perf_counter()
+        for g in range(nbatches):
+            b = build_transfers(rng, 6_000_000 + g * BATCH, BATCH)
+            ts2 += BATCH
+            ledger.drain(ledger.execute_async(
+                Operation.create_transfers, ts2, b
+            ))
+            n_sp += BATCH
+        out["spill_active_tps"] = round(n_sp / (time.perf_counter() - t0), 1)
+        out["spill_stats"] = dict(ledger.spill.stats)
+        assert ledger.spill.stats["cycles"] >= 2, "spill never engaged"
+
     return out
 
 
@@ -301,7 +348,7 @@ def bench_e2e(stage) -> dict:
     from tigerbeetle_tpu.benchmark import run_e2e
 
     n = int(os.environ.get("BENCH_E2E_TRANSFERS", 1_000_000))
-    clients = int(os.environ.get("BENCH_E2E_CLIENTS", 4))
+    clients = int(os.environ.get("BENCH_E2E_CLIENTS", 16))
     with stage("e2e_durable"):
         try:
             return run_e2e(

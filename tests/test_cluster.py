@@ -274,6 +274,7 @@ def test_commit_window_overlaps_journal_and_device():
     c1.request(op, body1)
     c2.request(op2, body2)
     cluster.network.run()
+    r.pump_commits()  # the real event loop calls this after each pump turn
 
     # Both ops are journaled AND dispatched (commit_min advanced) — op 2's
     # journal write happened while op 1's device batch was still in
@@ -304,9 +305,11 @@ def test_commit_window_overlaps_journal_and_device():
     # covered by the _inflight scan in _on_request (regression guard)
     c1.request(op, body1)
     cluster.network.run()
+    r.pump_commits()
     commit_after_dispatch = r.commit_min
     c1.resend()  # retransmit while dispatched-but-unfinalized
     cluster.network.run()
+    r.pump_commits()
     r.flush_commits()
     cluster.network.run()
     assert r.commit_min == commit_after_dispatch  # executed exactly once
@@ -372,3 +375,52 @@ def test_evicted_client_request_rejected():
     c0.request(op, types.accounts_to_np(events).tobytes())
     cluster.network.run()
     assert cluster.replicas[0].commit_min == commit  # not executed
+
+
+def test_group_commit_matches_oracle():
+    """Fused group commits (several quorum-ready create_transfers prepares
+    in ONE device dispatch) produce bit-identical state and replies vs the
+    scalar oracle replaying the same ops one at a time."""
+    from tigerbeetle_tpu.types import TRANSFER_DTYPE
+
+    cluster = Cluster(replica_count=1)
+    r = cluster.replicas[0]
+    clients = [cluster.add_client() for _ in range(4)]
+    r.commit_window = 8
+    committed = []
+    r.commit_hook = lambda h, b: committed.append(
+        (Operation(h.operation), h.timestamp, b)
+    )
+
+    # accounts 1..40
+    acc = np.zeros(40, dtype=types.ACCOUNT_DTYPE)
+    acc["id_lo"] = np.arange(1, 41)
+    acc["ledger"] = 1
+    acc["code"] = 1
+    clients[0].request(Operation.create_accounts, acc.tobytes())
+    cluster.network.run()
+    r.pump_commits()
+    r.flush_commits()
+    cluster.network.run()
+    clients[0].take_reply()
+
+    # four fast-tier transfer batches arriving in ONE pump turn -> one
+    # fused dispatch of k=4
+    for i, c in enumerate(clients):
+        arr = np.zeros(16, dtype=TRANSFER_DTYPE)
+        arr["id_lo"] = np.arange(1000 + i * 16, 1016 + i * 16)
+        arr["debit_account_id_lo"] = 1 + (np.arange(16) + i * 3) % 40
+        arr["credit_account_id_lo"] = 1 + (np.arange(16) + i * 3 + 7) % 40
+        arr["amount_lo"] = 1 + i
+        arr["ledger"] = 1
+        arr["code"] = 1
+        c.request(Operation.create_transfers, arr.tobytes())
+    cluster.network.run()
+    r.pump_commits()
+    assert r.ledger._group_cache, "group kernel was never used"
+    r.flush_commits()
+    cluster.network.run()
+    for c in clients:
+        h, reply = c.take_reply()
+        assert reply == b"", reply  # all ok
+    assert_matches_oracle(r, committed)

@@ -146,6 +146,13 @@ def test_grid_block_repair_from_peers():
 
     cluster.network.filters.append(count_syncs)
 
+    # The spilled volume may still sit in tree memtables; flush every
+    # replica identically (a deterministic local storage action) so the
+    # forest holds real grid blocks to corrupt and repair.
+    for r in cluster.replicas:
+        for tree in (r.forest.transfers, r.forest.posted):
+            tree.flush()
+
     grid = r1.forest.grid
     addr = next(
         a for a in range(1, grid.block_count + 1)

@@ -26,6 +26,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -86,11 +87,15 @@ class _BenchClient:
         self.bus.pump(timeout=0.0)
 
     def wait_reply(self, deadline_s: float = 120.0) -> tuple:
-        t0 = time.monotonic()
+        t0 = last_send = time.monotonic()
         while self.client.reply is None:
             self.pump()
-            if time.monotonic() - t0 > deadline_s:
+            now = time.monotonic()
+            if now - t0 > deadline_s:
                 raise TimeoutError("benchmark client: no reply")
+            if now - last_send > 5.0 and self.client.in_flight is not None:
+                self.client.resend()  # request/reply lost: retransmit
+                last_send = now
             if self.client.reply is None:
                 time.sleep(0.0001)
         return self.client.take_reply()
@@ -104,7 +109,7 @@ def run_e2e(
     n_accounts: int = 10_000,
     n_transfers: int = 1_000_000,
     batch: int = BATCH,
-    clients: int = 4,
+    clients: int = 16,
     warmup_batches: int = 2,
     jax_platform: str | None = None,
     tmpdir: str | None = None,
@@ -124,7 +129,8 @@ def run_e2e(
     port = free_port()
 
     slots_log2 = 14
-    while n_transfers + (warmup_batches + 1) * batch > (1 << slots_log2) // 2:
+    warm_est = warmup_batches + 16 + 4 + 2 + 1  # singles + group rounds
+    while n_transfers + warm_est * batch > (1 << slots_log2) // 2:
         slots_log2 += 1
     acct_log2 = max(14, (n_accounts * 2 + 2).bit_length())
 
@@ -150,11 +156,22 @@ def run_e2e(
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     try:
-        line = proc.stdout.readline()  # blocks until ready (TPU init)
-        if "listening" not in line:
-            rest = proc.stdout.read()
-            raise RuntimeError(f"bench server failed to start: {line}{rest}")
+        while True:  # skip [boot] trace lines until ready (TPU init)
+            line = proc.stdout.readline()
+            if "listening" in line:
+                break
+            if not line:
+                raise RuntimeError("bench server died before listening")
+            log(line.rstrip())
         log(f"server up on :{port} (slots 2^{slots_log2})")
+
+        # Keep draining server output: an unread pipe fills and BLOCKS the
+        # server's next print (debug mode would wedge the whole benchmark).
+        def _drain_stdout():
+            for out in proc.stdout:
+                log("[server]", out.rstrip())
+
+        threading.Thread(target=_drain_stdout, daemon=True).start()
         return _drive(
             proc, port, n_accounts, n_transfers, batch, clients,
             warmup_batches, log,
@@ -190,23 +207,47 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
         next_id += n
     log(f"{n_accounts} accounts in {time.monotonic() - t0:.1f}s")
 
+    # -- warmup rounds: singles compile the per-batch kernel; k
+    # simultaneous batches compile each fused group kernel (k=8/4/2) —
+    # lazily compiling those mid-run would stall the timed phase for
+    # tens of seconds each --
+    # One round per fused-kernel capacity the steady state will hit
+    # (DeviceLedger.GROUP_KS): a run of k pads to the next capacity, so
+    # min(capacity, clients) warms each kernel even when clients < 16.
+    from tigerbeetle_tpu.models.ledger import DeviceLedger
+
+    group_rounds = sorted(
+        {min(g, clients) for g in DeviceLedger.GROUP_KS if clients >= 2},
+        reverse=True,
+    )
+    group_rounds = [k for k in group_rounds if k >= 2]
+    rounds = [1] * warmup_batches + group_rounds
+    total_warm = sum(rounds)
+
     # -- build all transfer bodies up front (workload gen off the clock) --
     bodies = []
     next_id = 1_000_000
-    remaining = n_transfers + warmup_batches * batch
+    remaining = n_transfers + total_warm * batch
     while remaining > 0:
         n = min(batch, remaining)
         bodies.append(_transfers_body(rng, next_id, n, n_accounts))
         next_id += n
         remaining -= n
 
-    # -- warmup (create_transfers compile) --
-    for b in bodies[:warmup_batches]:
-        sessions[0].client.request(Operation.create_transfers, b)
-        _h, body = sessions[0].wait_reply()
-        assert body == b"", decode_results(body, Operation.create_transfers)[:3]
-    work = bodies[warmup_batches:]
-    log(f"warmup done ({warmup_batches} batches); timing {len(work)} batches")
+    wi = 0
+    for k in rounds:
+        grp = bodies[wi : wi + k]
+        wi += k
+        for s, b in zip(sessions, grp):
+            s.client.request(Operation.create_transfers, b)
+        for s, _b in zip(sessions, grp):
+            _h, body = s.wait_reply(deadline_s=600.0)  # compiles are slow
+            assert body == b"", decode_results(
+                body, Operation.create_transfers
+            )[:3]
+    work = bodies[total_warm:]
+    log(f"warmup done ({total_warm} batches, rounds {rounds}); "
+        f"timing {len(work)} batches")
 
     # -- timed phase: each session keeps one batch in flight --
     lat_ms: list[float] = []
@@ -220,13 +261,23 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
             inflight[s.client.client_id] = time.monotonic()
     deadline = t_start + max(600.0, n_transfers / 1000)
     done_batches = 0
+    resent: dict[int, float] = {}
     while inflight:
         progressed = False
         for s in sessions:
-            if s.client.client_id not in inflight:
+            cid = s.client.client_id
+            if cid not in inflight:
                 continue
             s.pump()
             if s.client.reply is None:
+                now = time.monotonic()
+                if (
+                    now - inflight[cid] > 5.0
+                    and now - resent.get(cid, 0.0) > 5.0
+                    and s.client.in_flight is not None
+                ):
+                    s.client.resend()  # lost under backpressure: retry
+                    resent[cid] = now
                 continue
             _h, body = s.client.take_reply()
             lat_ms.append(
@@ -247,7 +298,7 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
     wall = time.monotonic() - t_start
     n_timed = sum(len(b) // 128 for b in work)
     assert failures == 0, f"{failures} transfers failed"
-    total = n_timed + warmup_batches * batch  # all committed, amount=1 each
+    total = n_timed + total_warm * batch  # all committed, amount=1 each
     return _verify_and_report(
         sessions[0], n_accounts, total, wall, n_timed, lat_ms, clients, log
     )

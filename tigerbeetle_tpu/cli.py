@@ -88,7 +88,16 @@ def cmd_format(args) -> int:
 
 
 def cmd_start(args) -> int:
+    import faulthandler
     import os
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps stacks
+    debug_boot = bool(os.environ.get("TB_DEBUG"))
+
+    def boot(msg: str) -> None:
+        if debug_boot:
+            print(f"[boot] {msg}", file=sys.stderr, flush=True)
 
     plat = os.environ.get("TB_JAX_PLATFORM")
     if plat:  # tests pin the CPU backend for spawned servers
@@ -109,12 +118,16 @@ def cmd_start(args) -> int:
         account_slots_log2=args.account_slots_log2,
         transfer_slots_log2=args.transfer_slots_log2,
     )
+    boot("imports done")
     storage = _storage(args.file, cluster_cfg, create=False, grid_mb=args.grid_mb)
+    boot("storage open")
     bus = TCPMessageBus(addresses, args.replica, listen=True)
+    boot("bus bound")  # must not contain "listening": spawners match on it
     replica = Replica(
         args.replica, len(addresses), storage, bus, RealTime(),
         cluster_cfg, process_cfg,
     )
+    boot("replica constructed (device state allocated)")
     if args.aof:
         replica.aof = AOF(args.aof)
     replica.commit_window = args.commit_window
@@ -122,7 +135,9 @@ def cmd_start(args) -> int:
     if args.statsd:
         host, _, port = args.statsd.rpartition(":")
         statsd = StatsD(host or "127.0.0.1", int(port))
+    boot("opening (superblock + snapshot + WAL recovery)")
     replica.open()
+    boot("open done")
     print(
         f"replica {args.replica}/{len(addresses)} listening on "
         f"{addresses[args.replica][0]}:{addresses[args.replica][1]} "
@@ -137,12 +152,19 @@ def cmd_start(args) -> int:
     while True:
         # With async commits in flight, poll (timeout=0) so a quiet wire
         # flushes replies immediately; otherwise block one tick.
-        n = bus.pump(timeout=0.0 if replica._inflight else tick_s)
-        if n == 0:
-            # bus idle: drain the async commit window so replies go out
-            # (while frames keep arriving, dispatches pile into the window
-            # and journal/network work overlaps device execution)
-            replica.flush_commits()
+        busy = bool(replica._inflight)
+        n = bus.pump(timeout=0.0 if busy else tick_s)
+        if n > 0:
+            replica.pump_commits()  # same-turn arrivals fuse into a group
+        if n == 0 and busy:
+            # Bus idle: flush once the whole window's device results are
+            # computed — ONE device->host round trip then drains every
+            # in-flight batch (fetching earlier would pay a round trip
+            # per batch on high-latency transports).
+            if replica.commits_ready():
+                replica.flush_commits()
+            else:
+                time.sleep(0.0002)
         now = time.monotonic()
         if now - last_tick >= tick_s:
             last_tick = now

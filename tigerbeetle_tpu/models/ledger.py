@@ -1532,6 +1532,26 @@ def applied_insert_mask(dense: list[int], flags: np.ndarray) -> np.ndarray:
     return mask
 
 
+class PendingGroup:
+    """One fused device dispatch covering several batches (group commit):
+    a single flat results array [k * n_pad + 1] (last word = fault),
+    fetched ONCE for the whole group — the per-batch launch + transfer
+    latency that dominates a high-latency transport is paid 1/k times."""
+
+    __slots__ = ("results", "n_pad", "k", "host")
+
+    def __init__(self, results, n_pad: int, k: int):
+        self.results = results
+        self.n_pad = n_pad
+        self.k = k
+        self.host = None
+
+    def fetch(self):
+        if self.host is None:
+            self.host = np.asarray(self.results)
+        return self.host
+
+
 class PendingBatch:
     """Handle for an asynchronously dispatched commit (results still on
     device). The driver's pipelining unit — the analog of one in-flight
@@ -1539,17 +1559,19 @@ class PendingBatch:
     src/vsr/replica.zig:5102-5186, pipeline_prepare_queue_max=8)."""
 
     __slots__ = ("operation", "n", "results", "flags", "id_limbs", "dense",
-                 "epoch")
+                 "epoch", "group", "group_idx")
 
     def __init__(self, operation, n, results, flags=None, id_limbs=None,
-                 epoch=0):
+                 epoch=0, group=None, group_idx=0):
         self.operation = operation
         self.n = n
-        self.results = results  # device u32 [n_pad]
+        self.results = results  # device u32 [n_pad + 1]; last = fault word
         self.flags = flags  # host u16 [n] (occupancy reconciliation)
         self.id_limbs = id_limbs  # host (lo, hi) u64 [n] (sharded reconcile)
         self.dense = None  # cached drain() result (drain is idempotent)
         self.epoch = epoch  # occupancy epoch at dispatch (spill reconcile)
+        self.group = group  # PendingGroup when part of a fused dispatch
+        self.group_idx = group_idx  # this batch's row within the group
 
 
 class DeviceLedger(HostLedgerBase):
@@ -1671,6 +1693,21 @@ class DeviceLedger(HostLedgerBase):
             self._acct_used += n
         else:
             raise AssertionError(operation)
+        # Pack the fault word onto the results and START the device->host
+        # copy now: drain() then reads an already-landed buffer instead of
+        # paying three synchronous round trips (block + results + fault) —
+        # on a high-latency transport each costs ~100 ms, which would
+        # dominate the whole durable commit path.
+        results = jnp.concatenate(
+            [
+                results.astype(jnp.uint32),
+                self.state["fault"].reshape(1).astype(jnp.uint32),
+            ]
+        )
+        try:
+            results.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # transport without async copy: drain pays the sync cost
         return PendingBatch(
             operation, n, results, flags=arr["flags"].copy(),
             epoch=self._occupancy_epoch,
@@ -1709,6 +1746,109 @@ class DeviceLedger(HostLedgerBase):
         idx_pad[:n2] = idx
         return self.kernels.merge_results(r_fast, r_res, jnp.asarray(idx_pad))
 
+    # Fixed fused-group capacities: a lax.scan over K slots traces the
+    # commit kernel ONCE regardless of K (an unrolled K multiplies the
+    # graph and has broken the remote compiler); smaller runs pad with
+    # zero-count slots. Two capacities bound the padded-upload waste.
+    GROUP_KS = (16, 4)
+
+    def _group_stepper(self, k: int, n_pad: int):
+        """Jitted fused commit of k fast-tier batch slots in ONE launch
+        (group commit: the replica coalesces its pipeline the way the
+        flagship benchmark K-fuses device-generated batches). Returns
+        (state', flat results [k * n_pad + 1]; last word = fault)."""
+        cache = getattr(self, "_group_cache", None)
+        if cache is None:
+            cache = self._group_cache = {}
+        fn = cache.get((k, n_pad))
+        if fn is None:
+            kernels = self.kernels
+
+            def step(state, rows, ns, tss):
+                def body(st, x):
+                    r, n, t = x
+                    st, res = kernels._commit_transfers(
+                        st, {"rows": r}, n, t, mode="fast"
+                    )
+                    return st, res.astype(jnp.uint32)
+
+                state, results = jax.lax.scan(body, state, (rows, ns, tss))
+                return state, jnp.concatenate([
+                    results.reshape(-1),
+                    state["fault"].reshape(1).astype(jnp.uint32),
+                ])
+
+            fn = cache[(k, n_pad)] = jax.jit(step, donate_argnums=(0,))
+        return fn
+
+    def try_execute_group_async(self, items) -> list[PendingBatch] | None:
+        """Fuse `items` = [(timestamp, transfers ndarray), ...] into one
+        device dispatch, or return None when fusion is unsound — spill
+        store active (reloads mutate state between batches), forced mode,
+        or any batch not proven fast-tier. The caller falls back to
+        per-batch execute_async."""
+        if self.mode != "auto" or self.spill is not None or len(items) < 2:
+            return None
+        if getattr(self, "_group_disabled", False):
+            return None
+        items = items[: self.GROUP_KS[0]]
+        total = sum(len(arr) for _, arr in items)
+        if self._xfer_used + total > self._xfer_limit:
+            return None  # per-batch path raises the descriptive guard
+        # Probe tier decisions with rollback: split() advances the
+        # monotone amount_sum overflow bound (and split_stats), and a
+        # rejected fusion falls back to per-batch execute_async which
+        # calls split() AGAIN — without rollback every mixed-tier window
+        # double-counts toward the 2^127 serial cutoff.
+        sum_before = self.hazards.amount_sum
+        stats_before = dict(self.hazards.split_stats)
+        decisions = [self.hazards.split(arr) for _, arr in items]
+        if any(d != "fast" for d, _mask in decisions):
+            self.hazards.amount_sum = sum_before
+            self.hazards.split_stats = stats_before
+            return None
+        k = next(g for g in reversed(self.GROUP_KS) if g >= len(items))
+        n_pad = self._pad_for(max(len(arr) for _, arr in items))
+        rows = np.zeros((k, n_pad, ROW_WORDS), dtype=np.uint32)
+        ns = np.zeros(k, dtype=np.int32)  # padding slots: n=0 -> no-ops
+        tss = np.zeros(k, dtype=np.uint64)
+        for i, (ts, arr) in enumerate(items):
+            rows[i, : len(arr)] = arr.view(np.uint32).reshape(len(arr), ROW_WORDS)
+            ns[i] = len(arr)
+            tss[i] = ts
+        try:
+            state, flat = self._group_stepper(k, n_pad)(
+                self.state, jnp.asarray(rows), jnp.asarray(ns),
+                jnp.asarray(tss),
+            )
+        except Exception:
+            # A broken/flaky (remote) compile must not take the server
+            # down: fall back to per-batch dispatch. But the stepper
+            # donates self.state — a RUNTIME failure after donation leaves
+            # deleted buffers, and no fallback is sound; re-raise then.
+            for buf in self.state.values():
+                if getattr(buf, "is_deleted", lambda: False)():
+                    raise
+            self._group_disabled = True
+            return None
+        self.state = state
+        for _ts, arr in items:
+            self.hazards.note_pending(arr)
+        try:
+            flat.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        self._xfer_used += total
+        group = PendingGroup(flat, n_pad, k)
+        return [
+            PendingBatch(
+                Operation.create_transfers, len(arr), flat,
+                flags=arr["flags"].copy(), epoch=self._occupancy_epoch,
+                group=group, group_idx=i,
+            )
+            for i, (_ts, arr) in enumerate(items)
+        ]
+
     def check_fault(self) -> None:
         """Raise if the device hit the fault protocol (see module docstring).
         Synchronizes with the device — amortize on the hot path."""
@@ -1722,8 +1862,31 @@ class DeviceLedger(HostLedgerBase):
         the cached codes without double-reconciling."""
         if pending.dense is not None:
             return pending.dense
-        dense = [int(x) for x in np.asarray(pending.results)[: pending.n]]
-        self.check_fault()
+        if pending.group is not None:
+            g = pending.group
+            arr = g.fetch()  # one transfer for the whole group (cached)
+            off = pending.group_idx * g.n_pad
+            codes = arr[off : off + pending.n]
+            return self._drain_from_host(pending, codes, int(arr[-1]))
+        arr = np.asarray(pending.results)  # one transfer: results + fault
+        return self._drain_from_host(pending, arr[: pending.n], int(arr[-1]))
+
+    def drain_many(self, pendings) -> None:
+        """Materialize a window of pending batches. Each batch's
+        device->host copy was started AT DISPATCH (it pipelines right
+        behind the commit kernel), so draining the window costs one
+        wait for the oldest in-flight copy and the rest read landed
+        buffers — NOT one transport round trip per batch. (A device-side
+        concat would be worse: a fresh launch + fetch that ignores the
+        prefetched copies.)"""
+        for p in pendings:
+            if p is not None:
+                self.drain(p)
+
+    def _drain_from_host(self, pending: PendingBatch, codes,
+                         fault: int) -> list[int]:
+        raise_on_fault(fault, "device ledger")
+        dense = [int(x) for x in codes]
         applied = int(applied_insert_mask(dense, pending.flags).sum())
         if pending.operation == Operation.create_transfers:
             # A spill cycle after dispatch rebuilt the table and recounted

@@ -166,6 +166,29 @@ class StateMachine:
         )
         return (operation, self.backend.execute_async(operation, timestamp, events))
 
+    def commit_group_async(self, operation: Operation, batches):
+        """Fuse consecutive create_transfers commits into one device
+        dispatch (group commit). `batches` = [(timestamp, body), ...].
+        Returns a list of commit_async-compatible handles, or None when
+        fusion is unavailable/unsound — callers fall back per batch."""
+        if operation != Operation.create_transfers or len(batches) < 2:
+            return None
+        if not hasattr(self.backend, "try_execute_group_async"):
+            return None
+        items = [(ts, decode_transfers(body)) for ts, body in batches]
+        pendings = self.backend.try_execute_group_async(items)
+        if pendings is None:
+            return None
+        return [(operation, p) for p in pendings]
+
+    def commit_finish_many(self, handles) -> None:
+        """Pre-materialize several commit_async handles with one
+        device->host transfer (see DeviceLedger.drain_many); the
+        subsequent per-handle commit_finish calls hit the cache."""
+        pendings = [h[1] for h in handles if not isinstance(h, bytes)]
+        if pendings and hasattr(self.backend, "drain_many"):
+            self.backend.drain_many(pendings)
+
     def commit_finish(self, handle) -> bytes:
         """Materialize a commit_async handle into the reply body bytes."""
         if isinstance(handle, bytes):

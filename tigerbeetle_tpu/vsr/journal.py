@@ -16,7 +16,8 @@ src/vsr.zig:2003-2035 checkpoint arithmetic).
 
 from __future__ import annotations
 
-
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
 
 from tigerbeetle_tpu.constants import ConfigCluster
 from tigerbeetle_tpu.io.storage import SECTOR_SIZE, Storage, Zone
@@ -32,6 +33,20 @@ class Journal:
         # In-memory mirror of the redundant header ring (so a slot's header
         # write is a single-sector read-modify-write against this mirror).
         self._headers = bytearray(self.slot_count * HEADER_SIZE)
+        # Async write path (reference: journal IOPS pools, 8 write iops,
+        # src/config.zig:97-98): a small writer pool overlaps the 1 MiB
+        # O_DSYNC prepare writes with device commits and other requests.
+        # Created lazily — deterministic tests never touch it.
+        self._executor: ThreadPoolExecutor | None = None
+        self._sector_locks: dict[int, threading.Lock] = {}
+        self._locks_guard = threading.Lock()
+        self._pending_writes: set[Future] = set()
+        # Durable-header mirror: a slot's header enters this mirror (and
+        # therefore reaches the redundant ring on disk) only AFTER its own
+        # prepare write completed — a neighbor slot's sector write must
+        # never publish a header whose prepare is still in flight (the
+        # prepare-before-header ordering contract, per slot).
+        self._headers_durable = bytearray(self.slot_count * HEADER_SIZE)
 
     def slot_for_op(self, op: int) -> int:
         return op % self.slot_count
@@ -54,12 +69,70 @@ class Journal:
 
     def _write_header(self, slot: int, header: Header) -> None:
         off = slot * HEADER_SIZE
-        self._headers[off : off + HEADER_SIZE] = header.to_bytes()
-        sector = off // SECTOR_SIZE * SECTOR_SIZE
+        wire = header.to_bytes()
+        self._headers[off : off + HEADER_SIZE] = wire
+        self._headers_durable[off : off + HEADER_SIZE] = wire
+        self._write_header_sector(off // SECTOR_SIZE * SECTOR_SIZE)
+
+    def _write_header_sector(self, sector: int) -> None:
         self.storage.write(
             Zone.wal_headers, sector,
-            bytes(self._headers[sector : sector + SECTOR_SIZE]),
+            bytes(self._headers_durable[sector : sector + SECTOR_SIZE]),
         )
+
+    # -- async write path (the reply/ack waits on the future; everything
+    # else overlaps: reference journal write IOPS, src/config.zig:97-98) --
+
+    def write_prepare_async(self, header: Header, body: bytes) -> Future:
+        """Mirror-update now (synchronously — evidence scans see the op
+        immediately); the durable prepare + header-sector writes run on
+        the writer pool. The caller MUST await the future before acking
+        (prepare_ok / client reply): WAL-before-ack is the contract."""
+        assert header.command == Command.prepare
+        assert header.size == HEADER_SIZE + len(body)
+        slot = self.slot_for_op(header.op)
+        off = slot * HEADER_SIZE
+        self._headers[off : off + HEADER_SIZE] = header.to_bytes()
+        sector = off // SECTOR_SIZE * SECTOR_SIZE
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="journal"
+            )
+        wire = header.to_bytes() + body
+        fut = self._executor.submit(self._write_task, slot, sector, wire)
+        self._pending_writes.add(fut)
+        fut.add_done_callback(self._pending_writes.discard)
+        return fut
+
+    def quiesce(self) -> None:
+        """Wait for every in-flight async prepare write. Evidence surgery
+        (invalidate_above) and recovery-order-sensitive transitions must
+        not race a queued write that would re-populate a zeroed slot."""
+        for fut in list(self._pending_writes):
+            fut.result()
+
+    def submit_io(self, fn, *args) -> Future:
+        """FIFO background IO (client-reply slot writes): one worker, so
+        successive writes to the same slot land in submission order."""
+        if getattr(self, "_io_executor", None) is None:
+            self._io_executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="journal-io"
+            )
+        return self._io_executor.submit(fn, *args)
+
+    def _write_task(self, slot: int, sector: int, wire: bytes) -> None:
+        # prepare FIRST, then the redundant header (same ordering contract
+        # as the sync path). Concurrent slots may share a header sector:
+        # a slot's header enters the DURABLE mirror only here — after its
+        # own prepare landed — so a neighbor's sector write can never
+        # publish a header whose prepare is still in flight.
+        self.storage.write(Zone.wal_prepares, slot * self.msg_max, wire)
+        off = slot * HEADER_SIZE
+        with self._locks_guard:
+            lock = self._sector_locks.setdefault(sector, threading.Lock())
+        with lock:
+            self._headers_durable[off : off + HEADER_SIZE] = wire[:HEADER_SIZE]
+            self._write_header_sector(sector)
 
     def invalidate_above(self, op_max: int) -> None:
         """Destroy journal evidence for every op above `op_max` — BOTH the
@@ -74,6 +147,9 @@ class Journal:
         the op committed in the intervening view (replica divergence). The
         disk writes make the invalidation survive a restart (recover()
         would otherwise rebuild the mirror from the stale rings)."""
+        # An in-flight async write for a superseded op would land AFTER
+        # the zeroing below and resurrect the evidence: drain first.
+        self.quiesce()
         for slot in range(self.slot_count):
             off = slot * HEADER_SIZE
             h = Header.from_bytes(bytes(self._headers[off : off + HEADER_SIZE]))
@@ -82,11 +158,8 @@ class Journal:
             if h.op <= op_max:
                 continue
             self._headers[off : off + HEADER_SIZE] = bytes(HEADER_SIZE)
-            sector = off // SECTOR_SIZE * SECTOR_SIZE
-            self.storage.write(
-                Zone.wal_headers, sector,
-                bytes(self._headers[sector : sector + SECTOR_SIZE]),
-            )
+            self._headers_durable[off : off + HEADER_SIZE] = bytes(HEADER_SIZE)
+            self._write_header_sector(off // SECTOR_SIZE * SECTOR_SIZE)
             # Tear the prepare's own header sector too: recover() must not
             # resurrect the slot from the prepare ring.
             praw = self.storage.read(
@@ -179,4 +252,5 @@ class Journal:
             if r_ok:  # torn prepare: op known, body lost
                 self.faulty[slot] = r_header.op
                 self._headers[off : off + HEADER_SIZE] = r_header.to_bytes()
+        self._headers_durable = bytearray(self._headers)
         return out

@@ -299,6 +299,7 @@ class Replica:
 
     def tick(self) -> None:
         self.ticks += 1
+        self.pump_commits()  # deferred group commits (event-loop safety)
         self.flush_commits()  # bound reply latency to one tick worst-case
         if self.status == "normal":
             if self.is_primary:
@@ -549,8 +550,13 @@ class Replica:
         # Pipeline backpressure (reference: pipeline_prepare_queue_max=8):
         # while commits stall (lost quorum, partition), new requests must
         # not grow the uncommitted tail without bound — the WAL headroom is
-        # finite. The client retries.
-        if len(self.pipeline) >= self.cluster.pipeline_prepare_queue_max:
+        # finite. The client retries. With a commit window the cap widens
+        # to hold one full turn of deferred group commits (still far under
+        # the WAL-wrap guard).
+        cap = max(
+            self.cluster.pipeline_prepare_queue_max, 2 * self.commit_window
+        )
+        if len(self.pipeline) >= cap:
             return
 
         op = self.op + 1
@@ -584,14 +590,31 @@ class Replica:
         )
         prepare.set_checksum_body(body)
         prepare.set_checksum()
-        self.journal.write_prepare(prepare, body)
+        if self.commit_window > 0 and self.replica_count == 1:
+            # async WAL (reference: journal write IOPS): the reply waits
+            # on this future at finalize — WAL-before-ack holds while the
+            # 1 MiB O_DSYNC write overlaps device commits + other requests.
+            # Single replica only: a multi-replica primary's self-vote in
+            # `oks` is an implicit ack, and acks require a DURABLE prepare
+            # (a backup acks only after its synchronous write) — counting
+            # an un-landed write toward quorum could commit an op with
+            # fewer than quorum durable copies.
+            wal = self.journal.write_prepare_async(prepare, body)
+        else:
+            self.journal.write_prepare(prepare, body)
+            wal = None
         self.op = op
         self.parent_checksum = prepare.checksum
         self.pipeline[op] = {"header": prepare, "body": body,
-                             "oks": {self.replica}}
+                             "oks": {self.replica}, "wal": wal}
         for r in range(self.replica_count):
             if r != self.replica:
                 self.network.send(self.replica, r, prepare.to_bytes() + body)
+        if self.commit_window > 0 and self.replica_count == 1:
+            # defer to the event loop's end-of-pump pump_commits(): every
+            # request that arrived this turn then commits as ONE fused
+            # group instead of k separate device launches
+            return
         self._maybe_commit_pipeline()
 
     def _restore_client_replies(self) -> None:
@@ -1042,6 +1065,10 @@ class Replica:
         entry["oks"].add(header.replica)
         self._maybe_commit_pipeline()
 
+    # Max prepares fused into one group commit (the ledger pads smaller
+    # runs into fixed-capacity scan kernels — see DeviceLedger.GROUP_KS).
+    GROUP_MAX = 16
+
     def _maybe_commit_pipeline(self) -> None:
         committed = False
         while True:
@@ -1052,10 +1079,15 @@ class Replica:
             header, body = entry["header"], entry["body"]
             try:
                 if self.commit_window > 0:
+                    if self._commit_group(op, header):
+                        committed = True
+                        continue
                     # overlapped: dispatch now, drain/reply on flush — the
                     # next request's journal write + broadcast run while
                     # the device executes this batch
-                    self._inflight.append(self._commit_dispatch(header, body))
+                    d = self._commit_dispatch(header, body)
+                    d["wal"] = entry.get("wal")
+                    self._inflight.append(d)
                     self.flush_commits(keep=self.commit_window)
                 else:
                     reply_wire = self._commit_prepare(header, body)
@@ -1077,6 +1109,43 @@ class Replica:
             # tick cadence)
             h = Header(command=int(Command.commit), commit=self.commit_max)
             self._broadcast(h)
+
+    def _commit_group(self, first_op: int, first_header: Header) -> bool:
+        """Group commit: fuse a run of quorum-ready create_transfers
+        prepares into ONE device dispatch + ONE result fetch (reference
+        pipelining collapsed onto the device the way the flagship
+        benchmark K-fuses batches). Returns True if a group was
+        dispatched; False -> the caller takes the per-op path."""
+        if first_header.operation != int(Operation.create_transfers):
+            return False
+        run = []
+        while len(run) < self.GROUP_MAX:
+            e = self.pipeline.get(first_op + len(run))
+            if (
+                e is None
+                or len(e["oks"]) < self.quorum_replication
+                or e["header"].operation != int(Operation.create_transfers)
+            ):
+                break
+            run.append(e)
+        if len(run) < 2:
+            return False
+        handles = self.sm.commit_group_async(
+            Operation.create_transfers,
+            [(e["header"].timestamp, e["body"]) for e in run],
+        )
+        if handles is None:
+            return False  # ineligible (hazard tier / spill / mode)
+        for e, handle in zip(run, handles):
+            h = e["header"]
+            d = self._commit_dispatch(h, e["body"], handle=handle)
+            d["wal"] = e.get("wal")
+            self._inflight.append(d)
+            self.commit_min = self.commit_max = h.op
+            self.commit_checksum = h.checksum
+            del self.pipeline[h.op]
+        self.flush_commits(keep=self.commit_window)
+        return True
 
     def _on_commit(self, header: Header) -> None:
         if header.view < self.view or self.is_primary:
@@ -1146,7 +1215,8 @@ class Replica:
         primary actually sends it. Returns the reply wire bytes."""
         return self._commit_finalize(self._commit_dispatch(header, body))
 
-    def _commit_dispatch(self, header: Header, body: bytes) -> dict:
+    def _commit_dispatch(self, header: Header, body: bytes,
+                         handle=None) -> dict:
         """Stage 1: apply the prepare to the replicated state WITHOUT
         materializing device results (JAX async dispatch — create-op
         launches are queued and the host returns). Host-side effects that
@@ -1157,9 +1227,13 @@ class Replica:
         the same op — AOF records and commit hooks must not duplicate.
         AOF still precedes the reply (sent at finalize)."""
         operation = Operation(header.operation)
-        handle = None
         reply_body = None
-        if operation == Operation.register:
+        if handle is not None:
+            # group commit already dispatched the state-machine work
+            self.sm.prepare_timestamp = max(
+                self.sm.prepare_timestamp, header.timestamp
+            )
+        elif operation == Operation.register:
             # At clients_max, evict the OLDEST session (lowest session
             # number — deterministic, so every replica evicts the same
             # one) and tell that client (reference:
@@ -1211,6 +1285,9 @@ class Replica:
         """Stage 2: materialize the results (drains the device batch),
         build + store the reply, persist the client-replies slot."""
         header = entry["header"]
+        wal = entry.get("wal")
+        if wal is not None:
+            wal.result()  # WAL durable before the reply leaves
         reply_body = entry["reply_body"]
         if reply_body is None:
             reply_body = self.sm.commit_finish(entry["handle"])
@@ -1236,8 +1313,16 @@ class Replica:
             tentry["reply_checksum"] = reply.checksum
             if tentry.get("slot") is not None:
                 # persist so a post-restart primary can answer a duplicate
-                # with the ORIGINAL bytes (reference: client_replies.zig)
-                self.client_replies.write(tentry["slot"], wire)
+                # with the ORIGINAL bytes (reference: client_replies.zig);
+                # in window mode the O_DSYNC slot write rides the FIFO IO
+                # worker — reply repair tolerates a lost tail write (the
+                # checksum-validated restore reads it as absent)
+                if self.commit_window > 0:
+                    self.journal.submit_io(
+                        self.client_replies.write, tentry["slot"], wire
+                    )
+                else:
+                    self.client_replies.write(tentry["slot"], wire)
         return wire
 
     def flush_commits(self, keep: int = 0) -> None:
@@ -1245,11 +1330,43 @@ class Replica:
         `keep` remain in flight. The event loop calls this when the bus has
         no more incoming frames; _maybe_commit_pipeline calls it with
         keep=commit_window to bound the window."""
+        n_final = len(self._inflight) - keep
+        if n_final <= 0:
+            return
+        if n_final > 1:
+            # one device->host round trip for the whole window, not one
+            # per batch (high-latency transports)
+            self.sm.commit_finish_many([
+                e["handle"]
+                for e in list(self._inflight)[:n_final]
+                if e["handle"] is not None
+            ])
         while len(self._inflight) > keep:
             entry = self._inflight.popleft()
             wire = self._commit_finalize(entry)
             if wire is not None and entry["to_client"]:
                 self.network.send(self.replica, entry["header"].client, wire)
+
+    def pump_commits(self) -> None:
+        """Event-loop hook: commit whatever reached quorum during this
+        pump turn (deferred from _on_request so same-turn arrivals fuse
+        into one group dispatch)."""
+        if self.status == "normal" and self.is_primary and self.pipeline:
+            self._maybe_commit_pipeline()
+
+    def commits_ready(self) -> bool:
+        """True when the NEWEST in-flight commit's device results are
+        computed — batches execute in order, so the whole window is then
+        fetchable in one transfer. The event loop uses this to defer
+        flushes until one round trip can drain everything (fetching
+        mid-compute would serialize a round trip per batch)."""
+        if not self._inflight:
+            return False
+        h = self._inflight[-1]["handle"]
+        if h is None or isinstance(h, bytes):
+            return True
+        is_ready = getattr(h[1].results, "is_ready", None)
+        return bool(is_ready()) if is_ready is not None else True
 
     # ------------------------------------------------------------------
     # view change (reference: src/vsr/replica.zig:1595-1924)
