@@ -292,15 +292,28 @@ def bench_tracked_configs(stage) -> dict:
     # fraction of the workload, so the cold tail spills to the LSM forest
     # every few batches and the pre-commit reload path stays hot — the
     # bounded-memory cliff, measured rather than assumed.
-    with stage("cfg_spill"):
-        from tigerbeetle_tpu.constants import TEST_CLUSTER
-        from tigerbeetle_tpu.io.storage import MemoryStorage, ZoneLayout
-        from tigerbeetle_tpu.lsm.grid import Grid
-        from tigerbeetle_tpu.lsm.groove import Forest
+    try:
+        _bench_spill_config(stage, out, rng)
+    except Exception as e:  # never sink the whole benchmark
+        out["spill_active_tps"] = 0.0
+        out["spill_error"] = f"{type(e).__name__}: {e}"
+        print(f"[spill config] FAILED: {e}", file=sys.stderr)
 
-        layout = ZoneLayout(TEST_CLUSTER, grid_size=256 * 1024 * 1024)
+    return out
+
+
+def _bench_spill_config(stage, out, rng) -> None:
+    from tigerbeetle_tpu.constants import BATCH_PAD, TEST_CLUSTER, ConfigProcess
+    from tigerbeetle_tpu.io.storage import MemoryStorage, ZoneLayout
+    from tigerbeetle_tpu.lsm.grid import Grid
+    from tigerbeetle_tpu.lsm.groove import Forest
+    from tigerbeetle_tpu.models.ledger import DeviceLedger
+    from tigerbeetle_tpu.types import Operation
+
+    with stage("cfg_spill"):
+        layout = ZoneLayout(TEST_CLUSTER, grid_size=768 * 1024 * 1024)
         forest = Forest(Grid(
-            MemoryStorage(layout), offset=0, block_count=1792,
+            MemoryStorage(layout), offset=0, block_count=5760,
             cache_blocks=128,
         ))
         process = ConfigProcess(account_slots_log2=16,
@@ -331,11 +344,13 @@ def bench_tracked_configs(stage) -> dict:
                 Operation.create_transfers, ts2, b
             ))
             n_sp += BATCH
+            # the checkpoint-cadence free-set apply: staged releases from
+            # compaction churn become reusable, as the durable system's
+            # checkpoint chain would do (grid.py contract)
+            forest.grid.encode_free_set()
         out["spill_active_tps"] = round(n_sp / (time.perf_counter() - t0), 1)
         out["spill_stats"] = dict(ledger.spill.stats)
         assert ledger.spill.stats["cycles"] >= 2, "spill never engaged"
-
-    return out
 
 
 def bench_e2e(stage) -> dict:

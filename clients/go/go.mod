@@ -1,0 +1,3 @@
+module tigerbeetle_tpu/clients/go
+
+go 1.21

@@ -1,0 +1,185 @@
+// Go client for the tigerbeetle_tpu cluster: a cgo wrapper over the
+// tb_client C ABI (native/tb_client.{h,cc}), the same layering as the
+// reference's Go client (reference: src/clients/go/tb_client.go wraps
+// src/clients/c/tb_client.zig) — session registration, retries, checksums,
+// and wire framing live in the shared native library; this file converts
+// between Go types and the 128-byte wire structs.
+//
+// Build: the repo's CI image has no Go toolchain, so this package is
+// exercised by tests/test_go_client.py ONLY where `go` is available
+// (skipped otherwise). Build against the native library with:
+//
+//	CGO_CFLAGS="-I${REPO}/native" \
+//	CGO_LDFLAGS="-L${REPO}/native -ltb_native -Wl,-rpath,${REPO}/native" \
+//	go build ./...
+package tigerbeetle
+
+/*
+#cgo CFLAGS: -I.
+#include <stdint.h>
+#include <stdlib.h>
+#include "tb_client.h"
+*/
+import "C"
+
+import (
+	"crypto/rand"
+	"encoding/binary"
+	"errors"
+	"fmt"
+	"unsafe"
+)
+
+const (
+	opCreateAccounts  = 128
+	opCreateTransfers = 129
+	opLookupAccounts  = 130
+	opLookupTransfers = 131
+
+	eventSize  = 128
+	resultSize = 8
+	idSize     = 16
+)
+
+// U128 builds a little-endian Uint128 from lo/hi words.
+func U128(lo, hi uint64) Uint128 {
+	var out Uint128
+	binary.LittleEndian.PutUint64(out[:8], lo)
+	binary.LittleEndian.PutUint64(out[8:], hi)
+	return out
+}
+
+// Client is one session against the cluster. One in-flight request at a
+// time (the native layer enforces the session protocol).
+type Client struct {
+	handle *C.tb_client_t
+}
+
+// NewClient connects and registers a session. addresses:
+// "host:port[,host:port...]".
+func NewClient(addresses string, cluster uint32) (*Client, error) {
+	var id [16]byte
+	if _, err := rand.Read(id[:]); err != nil {
+		return nil, err
+	}
+	id[0] |= 1 // nonzero
+	caddr := C.CString(addresses)
+	defer C.free(unsafe.Pointer(caddr))
+	var handle *C.tb_client_t
+	rc := C.tb_client_init(
+		&handle, caddr, 0, C.uint32_t(cluster),
+		(*C.uint8_t)(unsafe.Pointer(&id[0])),
+	)
+	if rc != 0 {
+		return nil, fmt.Errorf("tb_client_init: errno %d", -int(rc))
+	}
+	return &Client{handle: handle}, nil
+}
+
+func (c *Client) Close() {
+	if c.handle != nil {
+		C.tb_client_deinit(c.handle)
+		c.handle = nil
+	}
+}
+
+func (c *Client) request(op uint8, body []byte, replyCap int) ([]byte, error) {
+	reply := make([]byte, replyCap)
+	var replyLen C.uint64_t
+	var bodyPtr unsafe.Pointer
+	if len(body) > 0 {
+		bodyPtr = unsafe.Pointer(&body[0])
+	}
+	rc := C.tb_client_request(
+		c.handle, C.uint8_t(op), bodyPtr, C.uint64_t(len(body)),
+		unsafe.Pointer(&reply[0]), C.uint64_t(replyCap), &replyLen,
+	)
+	if rc != 0 {
+		return nil, errors.New("tb_client_request failed")
+	}
+	return reply[:int(replyLen)], nil
+}
+
+// CreateAccounts submits a batch; returns sparse (index, result) pairs for
+// non-ok events (empty = all applied).
+func (c *Client) CreateAccounts(accounts []Account) ([]CreateAccountsResult, error) {
+	body := make([]byte, 0, len(accounts)*eventSize)
+	for i := range accounts {
+		body = append(body, structBytes(unsafe.Pointer(&accounts[i]))...)
+	}
+	reply, err := c.request(opCreateAccounts, body, len(accounts)*resultSize)
+	if err != nil {
+		return nil, err
+	}
+	out := make([]CreateAccountsResult, len(reply)/resultSize)
+	for i := range out {
+		out[i].Index = binary.LittleEndian.Uint32(reply[i*resultSize:])
+		out[i].Result = binary.LittleEndian.Uint32(reply[i*resultSize+4:])
+	}
+	return out, nil
+}
+
+// CreateTransfers submits a batch; returns sparse (index, result) pairs.
+func (c *Client) CreateTransfers(transfers []Transfer) ([]CreateTransfersResult, error) {
+	body := make([]byte, 0, len(transfers)*eventSize)
+	for i := range transfers {
+		body = append(body, structBytes(unsafe.Pointer(&transfers[i]))...)
+	}
+	reply, err := c.request(opCreateTransfers, body, len(transfers)*resultSize)
+	if err != nil {
+		return nil, err
+	}
+	out := make([]CreateTransfersResult, len(reply)/resultSize)
+	for i := range out {
+		out[i].Index = binary.LittleEndian.Uint32(reply[i*resultSize:])
+		out[i].Result = binary.LittleEndian.Uint32(reply[i*resultSize+4:])
+	}
+	return out, nil
+}
+
+// LookupAccounts returns the found accounts in request order (missing ids
+// skipped).
+func (c *Client) LookupAccounts(ids []Uint128) ([]Account, error) {
+	body := make([]byte, 0, len(ids)*idSize)
+	for i := range ids {
+		body = append(body, ids[i][:]...)
+	}
+	reply, err := c.request(opLookupAccounts, body, len(ids)*eventSize)
+	if err != nil {
+		return nil, err
+	}
+	out := make([]Account, len(reply)/eventSize)
+	for i := range out {
+		copy(structSlice(unsafe.Pointer(&out[i])), reply[i*eventSize:(i+1)*eventSize])
+	}
+	return out, nil
+}
+
+// LookupTransfers returns the found transfers in request order.
+func (c *Client) LookupTransfers(ids []Uint128) ([]Transfer, error) {
+	body := make([]byte, 0, len(ids)*idSize)
+	for i := range ids {
+		body = append(body, ids[i][:]...)
+	}
+	reply, err := c.request(opLookupTransfers, body, len(ids)*eventSize)
+	if err != nil {
+		return nil, err
+	}
+	out := make([]Transfer, len(reply)/eventSize)
+	for i := range out {
+		copy(structSlice(unsafe.Pointer(&out[i])), reply[i*eventSize:(i+1)*eventSize])
+	}
+	return out, nil
+}
+
+// The wire structs are fixed 128-byte little-endian extern layouts; the Go
+// struct definitions in types.go are laid out field-for-field identically
+// (all fields are fixed-size scalars/arrays, so Go inserts no padding on
+// 64-bit targets — guarded by the size check in sample/main.go).
+func structBytes(p unsafe.Pointer) []byte {
+	return unsafe.Slice((*byte)(p), eventSize)
+}
+
+func structSlice(p unsafe.Pointer) []byte {
+	return unsafe.Slice((*byte)(p), eventSize)
+}

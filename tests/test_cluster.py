@@ -424,3 +424,56 @@ def test_group_commit_matches_oracle():
         h, reply = c.take_reply()
         assert reply == b"", reply  # all ok
     assert_matches_oracle(r, committed)
+
+
+def test_standby_follows_without_voting():
+    """A standby (reference: src/vsr/replica.zig:163-175) journals and
+    commits the replicated stream but never acks or votes: quorums are
+    formed by the active set alone, and after a view change the standby
+    follows into the new view."""
+    from tigerbeetle_tpu.vsr.header import Command, Header
+
+    cluster = Cluster(replica_count=3, standby_count=1)
+    standby = cluster.replicas[3]
+    assert standby.standby
+
+    acks_from_standby = []
+
+    def sniff(src, dst, data):
+        h = Header.from_bytes(data[:128])
+        if src == 3 and h.command in (
+            Command.prepare_ok, Command.start_view_change,
+            Command.do_view_change,
+        ):
+            acks_from_standby.append(h.command)
+        return True
+
+    cluster.network.filters.append(sniff)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(81)
+    for op, body in _batch_bodies(gen, 4):
+        cluster.execute(client, op, body)
+    cluster.run_ticks(10)
+    head = cluster.replicas[0].commit_min
+    assert standby.commit_min == head  # followed the whole log
+    assert_identical_state(cluster.replicas)  # incl. the standby
+    assert not acks_from_standby  # never acked, never voted
+
+    # primary fails: the ACTIVE set elects view 1; the standby follows
+    cluster.detach_replica(0)
+    cluster.run_ticks(80)
+    live = cluster.replicas[1:3]
+    assert all(r.status == "normal" and r.view == 1 for r in live)
+    op, events = gen.gen_accounts_batch(16)
+    body = types.accounts_to_np(events).tobytes()
+    client.request(op, body)
+    cluster.network.run()
+    if client.reply is None:
+        client.resend()
+        cluster.network.run()
+    client.take_reply()
+    cluster.run_ticks(20)
+    assert standby.view == 1 and standby.status == "normal"
+    assert standby.commit_min == live[0].commit_min
+    assert not acks_from_standby
+    assert_identical_state(cluster.replicas[1:])

@@ -1,0 +1,54 @@
+"""Go client integration: builds the cgo wrapper + two-phase sample and
+runs it against a live server (reference: src/clients/go sample tests).
+The CI image ships no Go toolchain, so this skips unless `go` is on PATH
+— the Python/C client e2e (tests/test_process.py) covers the same wire
+surface either way."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from tests.test_process import REPO, _free_port, _spawn_server
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("go") is None, reason="no Go toolchain in this image"
+)
+
+
+def test_go_sample_two_phase(tmp_path):
+    path = str(tmp_path / "data.tigerbeetle")
+    port = _free_port()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    fmt = subprocess.run(
+        [sys.executable, "-m", "tigerbeetle_tpu", "format",
+         "--cluster", "0", "--replica", "0", "--replica-count", "1",
+         "--grid-mb", "8", path],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert fmt.returncode == 0, fmt.stderr
+    proc = _spawn_server(path, port)
+    try:
+        native = os.path.join(REPO, "native")
+        goenv = dict(
+            os.environ,
+            CGO_ENABLED="1",
+            CGO_CFLAGS=f"-I{native}",
+            CGO_LDFLAGS=f"-L{native} -ltb_native -Wl,-rpath,{native}",
+        )
+        build = subprocess.run(
+            ["go", "build", "-o", str(tmp_path / "sample"), "./sample"],
+            cwd=os.path.join(REPO, "clients", "go"),
+            env=goenv, capture_output=True, text=True, timeout=300,
+        )
+        assert build.returncode == 0, build.stderr
+        run = subprocess.run(
+            [str(tmp_path / "sample"), f"127.0.0.1:{port}"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert run.returncode == 0, run.stdout + run.stderr
+        assert "two-phase balances verified" in run.stdout
+    finally:
+        proc.kill()

@@ -387,3 +387,80 @@ def test_view_change_truncates_unreplicated_op_by_nacks():
     _commit_batches(cluster, client, gen, 1)
     assert all(r.commit_min == base_commit + 1 for r in live)
     assert_identical_state(live)
+
+
+def test_request_start_view_with_torn_suffix_body():
+    """A normal-status primary serving request_start_view with a TORN
+    prepare body in its suffix (media fault after ack) must serve the SV
+    from the redundant-header mirror and repair the body from a backup —
+    not crash on an assert (the fault class protocol-aware recovery is
+    built to tolerate)."""
+    from tigerbeetle_tpu.io.storage import Zone
+    from tigerbeetle_tpu.vsr.header import Command, Header
+
+    cluster = Cluster(replica_count=3)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(57)
+    _commit_batches(cluster, client, gen, 2)
+    r0 = cluster.replicas[0]
+    base = r0.commit_min
+
+    # hold prepare_oks so the next op stays in (commit_min, op]
+    held = []
+
+    def hold_oks(src, dst, data):
+        h = Header.from_bytes(data[:128])
+        if h.command == Command.prepare_ok:
+            held.append((src, dst, data))
+            return False
+        return True
+
+    cluster.network.filters.append(hold_oks)
+    op, events = gen.gen_accounts_batch(16)
+    client.request(op, types.accounts_to_np(events).tobytes())
+    cluster.network.run()
+    assert r0.op == base + 1 and r0.commit_min == base
+
+    # tear the primary's prepare BODY; the redundant header survives
+    slot = r0.journal.slot_for_op(base + 1)
+    cluster.storages[0].fault(
+        Zone.wal_prepares, slot * r0.journal.msg_max + 300, 128
+    )
+    assert r0.journal.read_prepare(base + 1) is None
+    assert r0.journal.get_header(base + 1) is not None
+
+    # a backup asks for the current start_view: must not crash, must
+    # carry the torn op's REAL header (from the mirror)
+    svs = []
+
+    def sniff(src, dst, data):
+        h = Header.from_bytes(data[:128])
+        if h.command == Command.start_view and src == 0:
+            svs.append((h, data[128 : h.size]))
+        return True
+
+    cluster.network.filters.append(sniff)
+    rsv = Header(command=int(Command.request_start_view), view=0)
+    rsv.set_checksum_body(b"")
+    rsv.replica = 2
+    rsv.set_checksum()
+    cluster.network.send(2, 0, rsv.to_bytes())
+    cluster.network.run()
+    assert svs, "primary did not serve the SV"
+    suffix_ops = {
+        Header.from_bytes(body[i : i + 128]).op
+        for _h, body in svs[:1]
+        for i in range(0, len(body), 128)
+    }
+    assert base + 1 in suffix_ops
+    # ...and the primary repaired the torn body from a backup
+    assert r0.journal.read_prepare(base + 1) is not None
+
+    # release the held acks: the op commits normally
+    cluster.network.filters.remove(hold_oks)
+    cluster.network.filters.remove(sniff)
+    for src, dst, data in held:
+        cluster.network.send(src, dst, data)
+    cluster.network.run()
+    assert r0.commit_min == base + 1
+    assert_identical_state(cluster.replicas)

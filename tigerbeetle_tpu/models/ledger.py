@@ -1622,6 +1622,12 @@ class DeviceLedger(HostLedgerBase):
         self._acct_limit = (1 << process.account_slots_log2) // 2
         self._xfer_limit = (1 << process.transfer_slots_log2) // 2
         self.hazards = HazardTracker()
+        # Start each batch's device->host result copy AT DISPATCH so a
+        # reply-serving driver (the VSR replica) drains landed buffers
+        # instead of paying sync round trips. OPT-IN: on transports where
+        # the first d2h permanently degrades dispatch (see bench.py), a
+        # fetch-free driver (the flagship benchmark) must never trigger it.
+        self.prefetch_results = False
 
     # ------------------------------------------------------------------
     # execution
@@ -1704,10 +1710,11 @@ class DeviceLedger(HostLedgerBase):
                 self.state["fault"].reshape(1).astype(jnp.uint32),
             ]
         )
-        try:
-            results.copy_to_host_async()
-        except (AttributeError, RuntimeError):
-            pass  # transport without async copy: drain pays the sync cost
+        if self.prefetch_results:
+            try:
+                results.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # no async copy: drain pays the sync cost
         return PendingBatch(
             operation, n, results, flags=arr["flags"].copy(),
             epoch=self._occupancy_epoch,
@@ -1834,10 +1841,11 @@ class DeviceLedger(HostLedgerBase):
         self.state = state
         for _ts, arr in items:
             self.hazards.note_pending(arr)
-        try:
-            flat.copy_to_host_async()
-        except (AttributeError, RuntimeError):
-            pass
+        if self.prefetch_results:
+            try:
+                flat.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
         self._xfer_used += total
         group = PendingGroup(flat, n_pad, k)
         return [

@@ -34,6 +34,7 @@ class Cluster:
         network: InProcessNetwork | None = None,
         seed: int = 0,
         forest_blocks: int = 0,
+        standby_count: int = 0,
     ):
         from tigerbeetle_tpu.constants import TEST_CLUSTER, TEST_PROCESS
 
@@ -54,7 +55,9 @@ class Cluster:
             and dst not in self.detached
         )
 
-        for i in range(replica_count):
+        self.standby_count = standby_count
+        self.replica_count = replica_count  # ACTIVE replicas only
+        for i in range(replica_count + standby_count):
             storage = MemoryStorage(self.layout, seed=seed * 97 + i)
             format_data_file(storage, self.cluster_config)
             self.storages.append(storage)
@@ -62,6 +65,7 @@ class Cluster:
                 i, replica_count, storage, self.network, self.time,
                 self.cluster_config, self.process_config, mode=mode,
                 backend_factory=backend_factory,
+                standby_count=standby_count,
             )
             r.open()
             self.replicas.append(r)
@@ -69,7 +73,7 @@ class Cluster:
     def add_client(self) -> Client:
         c = Client(
             CLIENT_ID_BASE + len(self.clients), self.network,
-            len(self.replicas),
+            self.replica_count,
         )
         self.clients.append(c)
         c.register()
@@ -111,10 +115,11 @@ class Cluster:
         """Crash-restart a replica over its surviving storage bytes."""
         old = self.replicas[index]
         r = Replica(
-            index, len(self.replicas), self.storages[index], self.network,
+            index, self.replica_count, self.storages[index], self.network,
             self.time, self.cluster_config, self.process_config,
             mode=self.mode,
             backend_factory=backend_factory or self.backend_factory,
+            standby_count=self.standby_count,
         )
         r.open()
         self.replicas[index] = r

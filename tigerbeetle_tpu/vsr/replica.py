@@ -85,9 +85,16 @@ class Replica:
         process: ConfigProcess,
         mode: str = "auto",
         backend_factory=None,
+        standby_count: int = 0,
     ):
         self.replica = replica_index
         self.replica_count = replica_count
+        # Standbys (reference: src/vsr/replica.zig:163-175): replicas with
+        # index >= replica_count follow the log — they journal prepares
+        # and commit — but never ack, never vote, and never count toward
+        # any quorum; a warm spare for operator-driven replacement.
+        self.standby_count = standby_count
+        self.standby = replica_index >= replica_count
         self.network = network
         self.time = time
         self.cluster = cluster
@@ -111,6 +118,11 @@ class Replica:
                 ))
             backend = DeviceLedger(cluster, process, mode=mode,
                                    forest=self.forest)
+        if hasattr(backend, "prefetch_results"):
+            # the replica drains results to serve replies: start copies at
+            # dispatch (a fetch-free driver like the flagship bench must
+            # NOT — see DeviceLedger.prefetch_results)
+            backend.prefetch_results = True
         self.ledger = backend
         self.sm = StateMachine(backend, cluster)
         self.journal = Journal(storage, cluster)
@@ -219,7 +231,7 @@ class Replica:
         while op in recovered:
             header, body = self.journal.read_prepare(op)  # type: ignore
             assert header.parent == self.parent_checksum
-            if self.replica_count == 1:
+            if self.replica_count == 1 and not self.standby:
                 # Single replica: every journaled op was committed (WAL is
                 # written before execution, and there is no one else).
                 self._commit_prepare(header, body)
@@ -478,7 +490,9 @@ class Replica:
         self.network.send(self.replica, dst, header.to_bytes() + body)
 
     def _broadcast(self, header: Header, body: bytes = b"") -> None:
-        for r in range(self.replica_count):
+        # standbys receive the replicated stream too (prepares, commits,
+        # SVs); they just never answer with votes or acks
+        for r in range(self.replica_count + self.standby_count):
             if r != self.replica:
                 self._send(r, dataclasses.replace(header), body)
 
@@ -702,6 +716,8 @@ class Replica:
             self._on_prepare(*nxt)
 
     def _ack_prepare(self, prepare: Header) -> None:
+        if self.standby:
+            return  # standbys follow; they never contribute to quorums
         ok = Header(
             command=int(Command.prepare_ok),
             op=prepare.op,
@@ -1374,6 +1390,16 @@ class Replica:
 
     def _start_view_change(self, new_view: int) -> None:
         assert new_view > self.view
+        if self.standby:
+            # a standby cannot vote a view in; it re-syncs via the
+            # authoritative start_view instead
+            rsv = Header(
+                command=int(Command.request_start_view), view=new_view
+            )
+            self._send(new_view % self.replica_count, rsv)
+            self._primary_contact_tick = self.ticks
+            self._recover_tick = self.ticks
+            return
         if self.status == "view_change" and new_view <= self.view_candidate:
             return
         self.flush_commits()  # no async commits across a status change
@@ -1396,7 +1422,7 @@ class Replica:
         self._check_svc_quorum()
 
     def _on_start_view_change(self, header: Header) -> None:
-        if header.view <= self.view:
+        if self.standby or header.view <= self.view:
             return
         if self.status != "view_change" or header.view > self.view_candidate:
             self._start_view_change(header.view)
