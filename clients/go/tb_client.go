@@ -84,6 +84,11 @@ func (c *Client) Close() {
 }
 
 func (c *Client) request(op uint8, body []byte, replyCap int) ([]byte, error) {
+	if replyCap == 0 {
+		// Empty batch: nothing to submit, and &reply[0] below would panic
+		// on a zero-length slice.
+		return nil, nil
+	}
 	reply := make([]byte, replyCap)
 	var replyLen C.uint64_t
 	var bodyPtr unsafe.Pointer

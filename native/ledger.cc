@@ -1,0 +1,953 @@
+// Native host ledger engine — the durable path's commit kernel.
+//
+// The reference's state machine is a CPU engine (reference:
+// src/state_machine.zig:612-1077: per-event create_account /
+// create_transfer / post-void over hash-indexed object stores, with
+// linked-chain scope rollback from src/lsm/groove.zig:990-1010). This is
+// the TPU build's host twin of that engine, sharing exact result-code
+// semantics with the JAX DeviceLedger and the Python oracle
+// (models/oracle.py): the replicated durable server computes reply codes
+// here at native speed, while the device ledger remains the TPU compute
+// path (flagship batches, sharded mesh, HBM residency). Parity between
+// the three is enforced by tests/test_native_ledger.py (golden tables +
+// randomized differential runs).
+//
+// Design: flat open-addressing tables (power-of-2, linear probe,
+// tombstones for chain-rollback deletes, grow at load 1/2) over the
+// 128-byte little-endian wire rows — no per-object allocation, no
+// pointer chasing; u128 arithmetic via __uint128_t with explicit
+// overflow checks mirroring sum_overflows (reference:
+// src/state_machine.zig:1152-1157).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <vector>
+
+namespace {
+
+typedef unsigned __int128 u128;
+
+constexpr uint64_t NS_PER_S = 1000000000ull;
+
+inline u128 mk128(uint64_t lo, uint64_t hi) {
+  return ((u128)hi << 64) | lo;
+}
+
+#pragma pack(push, 1)
+struct AccountRow {
+  uint64_t id_lo, id_hi;
+  uint64_t debits_pending_lo, debits_pending_hi;
+  uint64_t debits_posted_lo, debits_posted_hi;
+  uint64_t credits_pending_lo, credits_pending_hi;
+  uint64_t credits_posted_lo, credits_posted_hi;
+  uint64_t user_data_128_lo, user_data_128_hi;
+  uint64_t user_data_64;
+  uint32_t user_data_32;
+  uint32_t reserved;
+  uint32_t ledger;
+  uint16_t code;
+  uint16_t flags;
+  uint64_t timestamp;
+
+  u128 id() const { return mk128(id_lo, id_hi); }
+  u128 debits_pending() const { return mk128(debits_pending_lo, debits_pending_hi); }
+  u128 debits_posted() const { return mk128(debits_posted_lo, debits_posted_hi); }
+  u128 credits_pending() const { return mk128(credits_pending_lo, credits_pending_hi); }
+  u128 credits_posted() const { return mk128(credits_posted_lo, credits_posted_hi); }
+  void set_debits_pending(u128 v) { debits_pending_lo = (uint64_t)v; debits_pending_hi = (uint64_t)(v >> 64); }
+  void set_debits_posted(u128 v) { debits_posted_lo = (uint64_t)v; debits_posted_hi = (uint64_t)(v >> 64); }
+  void set_credits_pending(u128 v) { credits_pending_lo = (uint64_t)v; credits_pending_hi = (uint64_t)(v >> 64); }
+  void set_credits_posted(u128 v) { credits_posted_lo = (uint64_t)v; credits_posted_hi = (uint64_t)(v >> 64); }
+};
+
+struct TransferRow {
+  uint64_t id_lo, id_hi;
+  uint64_t debit_account_id_lo, debit_account_id_hi;
+  uint64_t credit_account_id_lo, credit_account_id_hi;
+  uint64_t amount_lo, amount_hi;
+  uint64_t pending_id_lo, pending_id_hi;
+  uint64_t user_data_128_lo, user_data_128_hi;
+  uint64_t user_data_64;
+  uint32_t user_data_32;
+  uint32_t timeout;
+  uint32_t ledger;
+  uint16_t code;
+  uint16_t flags;
+  uint64_t timestamp;
+
+  u128 id() const { return mk128(id_lo, id_hi); }
+  u128 debit_account_id() const { return mk128(debit_account_id_lo, debit_account_id_hi); }
+  u128 credit_account_id() const { return mk128(credit_account_id_lo, credit_account_id_hi); }
+  u128 amount() const { return mk128(amount_lo, amount_hi); }
+  u128 pending_id() const { return mk128(pending_id_lo, pending_id_hi); }
+  void set_amount(u128 v) { amount_lo = (uint64_t)v; amount_hi = (uint64_t)(v >> 64); }
+};
+#pragma pack(pop)
+
+static_assert(sizeof(AccountRow) == 128, "wire layout");
+static_assert(sizeof(TransferRow) == 128, "wire layout");
+
+// Account flags (reference: src/tigerbeetle.zig:42-62).
+constexpr uint16_t A_LINKED = 1 << 0;
+constexpr uint16_t A_DR_NOT_EXCEED_CR = 1 << 1;
+constexpr uint16_t A_CR_NOT_EXCEED_DR = 1 << 2;
+constexpr uint16_t A_PADDING = (uint16_t)~0x7u;
+
+// Transfer flags (reference: src/tigerbeetle.zig:91-104).
+constexpr uint16_t T_LINKED = 1 << 0;
+constexpr uint16_t T_PENDING = 1 << 1;
+constexpr uint16_t T_POST = 1 << 2;
+constexpr uint16_t T_VOID = 1 << 3;
+constexpr uint16_t T_BAL_DR = 1 << 4;
+constexpr uint16_t T_BAL_CR = 1 << 5;
+constexpr uint16_t T_PADDING = (uint16_t)~0x3Fu;
+
+// CreateAccountResult (reference: src/tigerbeetle.zig:109-144).
+enum AR : uint32_t {
+  AR_ok = 0, AR_linked_event_failed = 1, AR_linked_event_chain_open = 2,
+  AR_timestamp_must_be_zero = 3, AR_reserved_field = 4, AR_reserved_flag = 5,
+  AR_id_must_not_be_zero = 6, AR_id_must_not_be_int_max = 7,
+  AR_flags_are_mutually_exclusive = 8,
+  AR_debits_pending_must_be_zero = 9, AR_debits_posted_must_be_zero = 10,
+  AR_credits_pending_must_be_zero = 11, AR_credits_posted_must_be_zero = 12,
+  AR_ledger_must_not_be_zero = 13, AR_code_must_not_be_zero = 14,
+  AR_exists_with_different_flags = 15,
+  AR_exists_with_different_user_data_128 = 16,
+  AR_exists_with_different_user_data_64 = 17,
+  AR_exists_with_different_user_data_32 = 18,
+  AR_exists_with_different_ledger = 19, AR_exists_with_different_code = 20,
+  AR_exists = 21,
+};
+
+// CreateTransferResult (reference: src/tigerbeetle.zig:149-229).
+enum TR : uint32_t {
+  TR_ok = 0, TR_linked_event_failed = 1, TR_linked_event_chain_open = 2,
+  TR_timestamp_must_be_zero = 3, TR_reserved_flag = 4,
+  TR_id_must_not_be_zero = 5, TR_id_must_not_be_int_max = 6,
+  TR_flags_are_mutually_exclusive = 7,
+  TR_debit_account_id_must_not_be_zero = 8,
+  TR_debit_account_id_must_not_be_int_max = 9,
+  TR_credit_account_id_must_not_be_zero = 10,
+  TR_credit_account_id_must_not_be_int_max = 11,
+  TR_accounts_must_be_different = 12,
+  TR_pending_id_must_be_zero = 13, TR_pending_id_must_not_be_zero = 14,
+  TR_pending_id_must_not_be_int_max = 15, TR_pending_id_must_be_different = 16,
+  TR_timeout_reserved_for_pending_transfer = 17,
+  TR_amount_must_not_be_zero = 18,
+  TR_ledger_must_not_be_zero = 19, TR_code_must_not_be_zero = 20,
+  TR_debit_account_not_found = 21, TR_credit_account_not_found = 22,
+  TR_accounts_must_have_the_same_ledger = 23,
+  TR_transfer_must_have_the_same_ledger_as_accounts = 24,
+  TR_pending_transfer_not_found = 25, TR_pending_transfer_not_pending = 26,
+  TR_pending_transfer_has_different_debit_account_id = 27,
+  TR_pending_transfer_has_different_credit_account_id = 28,
+  TR_pending_transfer_has_different_ledger = 29,
+  TR_pending_transfer_has_different_code = 30,
+  TR_exceeds_pending_transfer_amount = 31,
+  TR_pending_transfer_has_different_amount = 32,
+  TR_pending_transfer_already_posted = 33,
+  TR_pending_transfer_already_voided = 34,
+  TR_pending_transfer_expired = 35,
+  TR_exists_with_different_flags = 36,
+  TR_exists_with_different_debit_account_id = 37,
+  TR_exists_with_different_credit_account_id = 38,
+  TR_exists_with_different_amount = 39,
+  TR_exists_with_different_pending_id = 40,
+  TR_exists_with_different_user_data_128 = 41,
+  TR_exists_with_different_user_data_64 = 42,
+  TR_exists_with_different_user_data_32 = 43,
+  TR_exists_with_different_timeout = 44,
+  TR_exists_with_different_code = 45,
+  TR_exists = 46,
+  TR_overflows_debits_pending = 47, TR_overflows_credits_pending = 48,
+  TR_overflows_debits_posted = 49, TR_overflows_credits_posted = 50,
+  TR_overflows_debits = 51, TR_overflows_credits = 52,
+  TR_overflows_timeout = 53,
+  TR_exceeds_credits = 54, TR_exceeds_debits = 55,
+};
+
+inline bool sum_overflows_128(u128 a, u128 b) {
+  return a + b < a;  // wraparound detection
+}
+inline bool sum_overflows_64(uint64_t a, uint64_t b) {
+  uint64_t out;
+  return __builtin_add_overflow(a, b, &out);
+}
+
+inline uint64_t hash_u128(u128 id) {
+  uint64_t lo = (uint64_t)id, hi = (uint64_t)(id >> 64);
+  uint64_t x = lo ^ (hi * 0x9E3779B97F4A7C15ull + 0xD1B54A32D192ED03ull);
+  x ^= x >> 33;
+  x *= 0xFF51AFD7ED558CCDull;
+  x ^= x >> 33;
+  x *= 0xC4CEB9FE1A85EC53ull;
+  x ^= x >> 33;
+  return x;
+}
+
+constexpr size_t NIL = (size_t)-1;
+
+// Flat open-addressing table over 128-byte rows keyed by the row's u128 id.
+// Linear probe over a SEPARATE key lane (16 B/slot: four keys per cache
+// line, so a probe chain rarely crosses one line) with a parallel state
+// lane; the 128-byte row lane is touched only on hit/insert. Tombstones
+// support chain-rollback deletes; grow at load 1/2. Keys of empty and
+// tombstone slots are 0 (state disambiguates), so probes compare the key
+// first and check state only on key match or termination.
+template <typename Row>
+struct Table {
+  std::vector<u128> keys;
+  std::vector<Row> rows;
+  std::vector<uint8_t> st;  // 0 empty, 1 full, 2 tombstone
+  uint64_t mask = 0;
+  size_t used = 0;  // full + tombstones (probe-length bound)
+  size_t live = 0;  // full
+
+  void init(size_t cap_log2) {
+    size_t cap = (size_t)1 << cap_log2;
+    keys.assign(cap, 0);
+    rows.assign(cap, Row{});
+    st.assign(cap, 0);
+    mask = cap - 1;
+    used = live = 0;
+  }
+
+  inline void prefetch(u128 id) const {
+    __builtin_prefetch(&keys[hash_u128(id) & mask]);
+  }
+
+  size_t find(u128 id) const {
+    size_t i = hash_u128(id) & mask;
+    while (true) {
+      if (keys[i] == id && st[i] == 1) return i;
+      if (st[i] == 0) return NIL;
+      i = (i + 1) & mask;
+    }
+  }
+
+  // Slot to insert `id` at (reuses tombstones); id must be absent.
+  size_t slot_for_insert(u128 id) {
+    size_t i = hash_u128(id) & mask;
+    size_t tomb = NIL;
+    while (true) {
+      if (st[i] == 0) return tomb != NIL ? tomb : i;
+      if (st[i] == 2 && tomb == NIL) tomb = i;
+      i = (i + 1) & mask;
+    }
+  }
+
+  void insert(u128 id, const Row &r) {
+    if ((used + 1) * 2 > rows.size()) grow();
+    size_t i = slot_for_insert(id);
+    if (st[i] != 2) used++;
+    st[i] = 1;
+    keys[i] = id;
+    rows[i] = r;
+    live++;
+  }
+
+  void erase_slot(size_t i) {
+    st[i] = 2;
+    keys[i] = 0;
+    rows[i] = Row{};
+    live--;
+  }
+
+  void grow() {
+    std::vector<Row> old_rows;
+    std::vector<uint8_t> old_st;
+    old_rows.swap(rows);
+    old_st.swap(st);
+    size_t cap = old_rows.size() * 2;
+    keys.assign(cap, 0);
+    rows.assign(cap, Row{});
+    st.assign(cap, 0);
+    mask = cap - 1;
+    used = live = 0;
+    for (size_t i = 0; i < old_rows.size(); i++) {
+      if (old_st[i] == 1) insert(old_rows[i].id(), old_rows[i]);
+    }
+  }
+};
+
+// Posted groove: pending timestamp -> POSTED(1) | VOIDED(2) (reference:
+// src/state_machine.zig:185-198 PostedGrooveValue).
+struct PostedTable {
+  struct Entry { uint64_t ts; uint8_t val; uint8_t state; };
+  std::vector<Entry> e;
+  uint64_t mask = 0;
+  size_t used = 0, live = 0;
+
+  void init(size_t cap_log2) {
+    e.assign((size_t)1 << cap_log2, Entry{0, 0, 0});
+    mask = e.size() - 1;
+    used = live = 0;
+  }
+  size_t find(uint64_t ts) const {
+    size_t i = hash_u128((u128)ts) & mask;
+    while (true) {
+      if (e[i].state == 0) return NIL;
+      if (e[i].state == 1 && e[i].ts == ts) return i;
+      i = (i + 1) & mask;
+    }
+  }
+  void insert(uint64_t ts, uint8_t val) {
+    if ((used + 1) * 2 > e.size()) grow();
+    size_t i = hash_u128((u128)ts) & mask;
+    size_t tomb = NIL;
+    while (true) {
+      if (e[i].state == 0) break;
+      if (e[i].state == 2 && tomb == NIL) tomb = i;
+      i = (i + 1) & mask;
+    }
+    if (tomb != NIL) i = tomb; else used++;
+    e[i] = Entry{ts, val, 1};
+    live++;
+  }
+  void erase(uint64_t ts) {
+    size_t i = find(ts);
+    if (i != NIL) { e[i].state = 2; live--; }
+  }
+  void grow() {
+    std::vector<Entry> old;
+    old.swap(e);
+    e.assign(old.size() * 2, Entry{0, 0, 0});
+    mask = e.size() - 1;
+    used = live = 0;
+    for (auto &x : old) if (x.state == 1) insert(x.ts, x.val);
+  }
+};
+
+// Linked-chain rollback scope (reference: src/lsm/groove.zig:990-1010 via
+// models/oracle.py _Scope): prior values of mutated keys, restored in
+// reverse on chain break.
+struct Undo {
+  enum Kind : uint8_t { ACCT, XFER, POSTED };
+  struct Item {
+    Kind kind;
+    bool existed;
+    u128 id;        // acct/xfer key
+    uint64_t ts;    // posted key
+    AccountRow acct;
+    TransferRow xfer;
+    uint8_t posted_val;
+  };
+  std::vector<Item> items;
+  bool open = false;
+};
+
+struct Ledger {
+  Table<AccountRow> accounts;
+  Table<TransferRow> transfers;
+  PostedTable posted;
+  uint64_t commit_timestamp = 0;
+  Undo scope;
+};
+
+void scope_note_account(Ledger &L, u128 id) {
+  if (!L.scope.open) return;
+  Undo::Item it{};
+  it.kind = Undo::ACCT;
+  it.id = id;
+  size_t s = L.accounts.find(id);
+  it.existed = s != NIL;
+  if (it.existed) it.acct = L.accounts.rows[s];
+  L.scope.items.push_back(it);
+}
+
+void scope_note_transfer(Ledger &L, u128 id) {
+  if (!L.scope.open) return;
+  Undo::Item it{};
+  it.kind = Undo::XFER;
+  it.id = id;
+  size_t s = L.transfers.find(id);
+  it.existed = s != NIL;
+  if (it.existed) it.xfer = L.transfers.rows[s];
+  L.scope.items.push_back(it);
+}
+
+void scope_note_posted(Ledger &L, uint64_t ts) {
+  if (!L.scope.open) return;
+  Undo::Item it{};
+  it.kind = Undo::POSTED;
+  it.ts = ts;
+  size_t s = L.posted.find(ts);
+  it.existed = s != NIL;
+  if (it.existed) it.posted_val = L.posted.e[s].val;
+  L.scope.items.push_back(it);
+}
+
+void scope_rollback(Ledger &L) {
+  for (size_t k = L.scope.items.size(); k-- > 0;) {
+    const Undo::Item &it = L.scope.items[k];
+    switch (it.kind) {
+      case Undo::ACCT: {
+        size_t s = L.accounts.find(it.id);
+        if (it.existed) {
+          if (s != NIL) L.accounts.rows[s] = it.acct;
+          else L.accounts.insert(it.id, it.acct);
+        } else if (s != NIL) {
+          L.accounts.erase_slot(s);
+        }
+        break;
+      }
+      case Undo::XFER: {
+        size_t s = L.transfers.find(it.id);
+        if (it.existed) {
+          if (s != NIL) L.transfers.rows[s] = it.xfer;
+          else L.transfers.insert(it.id, it.xfer);
+        } else if (s != NIL) {
+          L.transfers.erase_slot(s);
+        }
+        break;
+      }
+      case Undo::POSTED: {
+        if (it.existed) {
+          size_t s = L.posted.find(it.ts);
+          if (s != NIL) L.posted.e[s].val = it.posted_val;
+          else L.posted.insert(it.ts, it.posted_val);
+        } else {
+          L.posted.erase(it.ts);
+        }
+        break;
+      }
+    }
+  }
+  L.scope.items.clear();
+}
+
+// --- create_account (reference: src/state_machine.zig:738-777) ---
+
+uint32_t create_account(Ledger &L, const AccountRow &a) {
+  u128 id = a.id();
+  if (a.reserved != 0) return AR_reserved_field;
+  if (a.flags & A_PADDING) return AR_reserved_flag;
+  if (id == 0) return AR_id_must_not_be_zero;
+  if (id == ~(u128)0) return AR_id_must_not_be_int_max;
+  if ((a.flags & A_DR_NOT_EXCEED_CR) && (a.flags & A_CR_NOT_EXCEED_DR))
+    return AR_flags_are_mutually_exclusive;
+  if (a.debits_pending() != 0) return AR_debits_pending_must_be_zero;
+  if (a.debits_posted() != 0) return AR_debits_posted_must_be_zero;
+  if (a.credits_pending() != 0) return AR_credits_pending_must_be_zero;
+  if (a.credits_posted() != 0) return AR_credits_posted_must_be_zero;
+  if (a.ledger == 0) return AR_ledger_must_not_be_zero;
+  if (a.code == 0) return AR_code_must_not_be_zero;
+
+  size_t s = L.accounts.find(id);
+  if (s != NIL) {
+    const AccountRow &e = L.accounts.rows[s];
+    // reference: src/state_machine.zig:767-777
+    if (a.flags != e.flags) return AR_exists_with_different_flags;
+    if (a.user_data_128_lo != e.user_data_128_lo ||
+        a.user_data_128_hi != e.user_data_128_hi)
+      return AR_exists_with_different_user_data_128;
+    if (a.user_data_64 != e.user_data_64)
+      return AR_exists_with_different_user_data_64;
+    if (a.user_data_32 != e.user_data_32)
+      return AR_exists_with_different_user_data_32;
+    if (a.ledger != e.ledger) return AR_exists_with_different_ledger;
+    if (a.code != e.code) return AR_exists_with_different_code;
+    return AR_exists;
+  }
+
+  scope_note_account(L, id);
+  L.accounts.insert(id, a);
+  L.commit_timestamp = a.timestamp;
+  return AR_ok;
+}
+
+// --- post/void (reference: src/state_machine.zig:907-1077) ---
+
+uint32_t post_or_void(Ledger &L, const TransferRow &t) {
+  u128 id = t.id();
+  if ((t.flags & T_POST) && (t.flags & T_VOID))
+    return TR_flags_are_mutually_exclusive;
+  if (t.flags & T_PENDING) return TR_flags_are_mutually_exclusive;
+  if (t.flags & T_BAL_DR) return TR_flags_are_mutually_exclusive;
+  if (t.flags & T_BAL_CR) return TR_flags_are_mutually_exclusive;
+
+  u128 pid = t.pending_id();
+  if (pid == 0) return TR_pending_id_must_not_be_zero;
+  if (pid == ~(u128)0) return TR_pending_id_must_not_be_int_max;
+  if (pid == id) return TR_pending_id_must_be_different;
+  if (t.timeout != 0) return TR_timeout_reserved_for_pending_transfer;
+
+  size_t ps = L.transfers.find(pid);
+  if (ps == NIL) return TR_pending_transfer_not_found;
+  TransferRow p = L.transfers.rows[ps];
+  if (!(p.flags & T_PENDING)) return TR_pending_transfer_not_pending;
+
+  size_t drs = L.accounts.find(p.debit_account_id());
+  size_t crs = L.accounts.find(p.credit_account_id());
+  // pending transfer's accounts exist (they were checked at its creation)
+  AccountRow dr = L.accounts.rows[drs];
+  AccountRow cr = L.accounts.rows[crs];
+
+  if (t.debit_account_id() > 0 && t.debit_account_id() != p.debit_account_id())
+    return TR_pending_transfer_has_different_debit_account_id;
+  if (t.credit_account_id() > 0 && t.credit_account_id() != p.credit_account_id())
+    return TR_pending_transfer_has_different_credit_account_id;
+  if (t.ledger > 0 && t.ledger != p.ledger)
+    return TR_pending_transfer_has_different_ledger;
+  if (t.code > 0 && t.code != p.code)
+    return TR_pending_transfer_has_different_code;
+
+  u128 amount = t.amount() > 0 ? t.amount() : p.amount();
+  if (amount > p.amount()) return TR_exceeds_pending_transfer_amount;
+  if ((t.flags & T_VOID) && amount < p.amount())
+    return TR_pending_transfer_has_different_amount;
+
+  size_t es = L.transfers.find(id);
+  if (es != NIL) {
+    const TransferRow &e = L.transfers.rows[es];
+    // reference: src/state_machine.zig:1016-1077
+    if (t.flags != e.flags) return TR_exists_with_different_flags;
+    if (t.amount() == 0) {
+      if (e.amount() != p.amount()) return TR_exists_with_different_amount;
+    } else if (t.amount() != e.amount()) {
+      return TR_exists_with_different_amount;
+    }
+    if (t.pending_id() != e.pending_id())
+      return TR_exists_with_different_pending_id;
+    if (mk128(t.user_data_128_lo, t.user_data_128_hi) == 0) {
+      if (e.user_data_128_lo != p.user_data_128_lo ||
+          e.user_data_128_hi != p.user_data_128_hi)
+        return TR_exists_with_different_user_data_128;
+    } else if (t.user_data_128_lo != e.user_data_128_lo ||
+               t.user_data_128_hi != e.user_data_128_hi) {
+      return TR_exists_with_different_user_data_128;
+    }
+    if (t.user_data_64 == 0) {
+      if (e.user_data_64 != p.user_data_64)
+        return TR_exists_with_different_user_data_64;
+    } else if (t.user_data_64 != e.user_data_64) {
+      return TR_exists_with_different_user_data_64;
+    }
+    if (t.user_data_32 == 0) {
+      if (e.user_data_32 != p.user_data_32)
+        return TR_exists_with_different_user_data_32;
+    } else if (t.user_data_32 != e.user_data_32) {
+      return TR_exists_with_different_user_data_32;
+    }
+    return TR_exists;
+  }
+
+  size_t fs = L.posted.find(p.timestamp);
+  if (fs != NIL) {
+    return L.posted.e[fs].val == 1 ? TR_pending_transfer_already_posted
+                                   : TR_pending_transfer_already_voided;
+  }
+
+  if (p.timeout > 0) {
+    uint64_t timeout_ns = (uint64_t)p.timeout * NS_PER_S;
+    if (t.timestamp >= p.timestamp + timeout_ns)
+      return TR_pending_transfer_expired;
+  }
+
+  TransferRow t2{};
+  t2.id_lo = t.id_lo; t2.id_hi = t.id_hi;
+  t2.debit_account_id_lo = p.debit_account_id_lo;
+  t2.debit_account_id_hi = p.debit_account_id_hi;
+  t2.credit_account_id_lo = p.credit_account_id_lo;
+  t2.credit_account_id_hi = p.credit_account_id_hi;
+  if (mk128(t.user_data_128_lo, t.user_data_128_hi) > 0) {
+    t2.user_data_128_lo = t.user_data_128_lo;
+    t2.user_data_128_hi = t.user_data_128_hi;
+  } else {
+    t2.user_data_128_lo = p.user_data_128_lo;
+    t2.user_data_128_hi = p.user_data_128_hi;
+  }
+  t2.user_data_64 = t.user_data_64 > 0 ? t.user_data_64 : p.user_data_64;
+  t2.user_data_32 = t.user_data_32 > 0 ? t.user_data_32 : p.user_data_32;
+  t2.ledger = p.ledger;
+  t2.code = p.code;
+  t2.pending_id_lo = t.pending_id_lo;
+  t2.pending_id_hi = t.pending_id_hi;
+  t2.timeout = 0;
+  t2.timestamp = t.timestamp;
+  t2.flags = t.flags;
+  t2.set_amount(amount);
+
+  scope_note_transfer(L, id);
+  L.transfers.insert(id, t2);
+  scope_note_posted(L, p.timestamp);
+  L.posted.insert(p.timestamp, (t.flags & T_POST) ? 1 : 2);
+
+  scope_note_account(L, dr.id());
+  scope_note_account(L, cr.id());
+  dr.set_debits_pending(dr.debits_pending() - p.amount());
+  cr.set_credits_pending(cr.credits_pending() - p.amount());
+  if (t.flags & T_POST) {
+    dr.set_debits_posted(dr.debits_posted() + amount);
+    cr.set_credits_posted(cr.credits_posted() + amount);
+  }
+  // re-find: the transfer insert may have grown nothing, but the account
+  // table is stable here (no account inserts since drs/crs) — still,
+  // refresh via find for safety against future edits
+  L.accounts.rows[L.accounts.find(dr.id())] = dr;
+  L.accounts.rows[L.accounts.find(cr.id())] = cr;
+
+  L.commit_timestamp = t.timestamp;
+  return TR_ok;
+}
+
+// --- create_transfer (reference: src/state_machine.zig:779-905) ---
+
+uint32_t create_transfer(Ledger &L, const TransferRow &t) {
+  u128 id = t.id();
+  if (t.flags & T_PADDING) return TR_reserved_flag;
+  if (id == 0) return TR_id_must_not_be_zero;
+  if (id == ~(u128)0) return TR_id_must_not_be_int_max;
+
+  if (t.flags & (T_POST | T_VOID)) return post_or_void(L, t);
+
+  u128 dr_id = t.debit_account_id(), cr_id = t.credit_account_id();
+  if (dr_id == 0) return TR_debit_account_id_must_not_be_zero;
+  if (dr_id == ~(u128)0) return TR_debit_account_id_must_not_be_int_max;
+  if (cr_id == 0) return TR_credit_account_id_must_not_be_zero;
+  if (cr_id == ~(u128)0) return TR_credit_account_id_must_not_be_int_max;
+  if (cr_id == dr_id) return TR_accounts_must_be_different;
+
+  if (t.pending_id() != 0) return TR_pending_id_must_be_zero;
+  if (!(t.flags & T_PENDING) && t.timeout != 0)
+    return TR_timeout_reserved_for_pending_transfer;
+  if (!(t.flags & (T_BAL_DR | T_BAL_CR)) && t.amount() == 0)
+    return TR_amount_must_not_be_zero;
+
+  if (t.ledger == 0) return TR_ledger_must_not_be_zero;
+  if (t.code == 0) return TR_code_must_not_be_zero;
+
+  size_t drs = L.accounts.find(dr_id);
+  if (drs == NIL) return TR_debit_account_not_found;
+  size_t crs = L.accounts.find(cr_id);
+  if (crs == NIL) return TR_credit_account_not_found;
+  AccountRow dr = L.accounts.rows[drs];
+  AccountRow cr = L.accounts.rows[crs];
+
+  if (dr.ledger != cr.ledger) return TR_accounts_must_have_the_same_ledger;
+  if (t.ledger != dr.ledger)
+    return TR_transfer_must_have_the_same_ledger_as_accounts;
+
+  // An existing transfer must not influence overflow/limit checks
+  // (reference: src/state_machine.zig:823-824).
+  size_t es = L.transfers.find(id);
+  if (es != NIL) {
+    const TransferRow &e = L.transfers.rows[es];
+    // reference: src/state_machine.zig:886-905
+    if (t.flags != e.flags) return TR_exists_with_different_flags;
+    if (t.debit_account_id() != e.debit_account_id())
+      return TR_exists_with_different_debit_account_id;
+    if (t.credit_account_id() != e.credit_account_id())
+      return TR_exists_with_different_credit_account_id;
+    if (t.amount() != e.amount()) return TR_exists_with_different_amount;
+    if (t.user_data_128_lo != e.user_data_128_lo ||
+        t.user_data_128_hi != e.user_data_128_hi)
+      return TR_exists_with_different_user_data_128;
+    if (t.user_data_64 != e.user_data_64)
+      return TR_exists_with_different_user_data_64;
+    if (t.user_data_32 != e.user_data_32)
+      return TR_exists_with_different_user_data_32;
+    if (t.timeout != e.timeout) return TR_exists_with_different_timeout;
+    if (t.code != e.code) return TR_exists_with_different_code;
+    return TR_exists;
+  }
+
+  u128 amount = t.amount();
+  if (t.flags & (T_BAL_DR | T_BAL_CR)) {
+    if (amount == 0) amount = (u128)UINT64_MAX;  // reference: :829 (u64 max)
+  }
+  if (t.flags & T_BAL_DR) {
+    u128 dr_balance = dr.debits_posted() + dr.debits_pending();
+    u128 headroom = dr.credits_posted() > dr_balance
+                        ? dr.credits_posted() - dr_balance : 0;
+    if (headroom < amount) amount = headroom;
+    if (amount == 0) return TR_exceeds_credits;
+  }
+  if (t.flags & T_BAL_CR) {
+    u128 cr_balance = cr.credits_posted() + cr.credits_pending();
+    u128 headroom = cr.debits_posted() > cr_balance
+                        ? cr.debits_posted() - cr_balance : 0;
+    if (headroom < amount) amount = headroom;
+    if (amount == 0) return TR_exceeds_debits;
+  }
+
+  if (t.flags & T_PENDING) {
+    if (sum_overflows_128(amount, dr.debits_pending()))
+      return TR_overflows_debits_pending;
+    if (sum_overflows_128(amount, cr.credits_pending()))
+      return TR_overflows_credits_pending;
+  }
+  if (sum_overflows_128(amount, dr.debits_posted()))
+    return TR_overflows_debits_posted;
+  if (sum_overflows_128(amount, cr.credits_posted()))
+    return TR_overflows_credits_posted;
+  // debits_pending + debits_posted itself cannot wrap here: both were
+  // built by guarded additions, so their true sum fits u128 only if...
+  // it CAN wrap in adversarial snapshots; mirror the oracle's exact math
+  // (python ints don't wrap): detect either partial or total wrap.
+  if (sum_overflows_128(dr.debits_pending(), dr.debits_posted()) ||
+      sum_overflows_128(amount, dr.debits_pending() + dr.debits_posted()))
+    return TR_overflows_debits;
+  if (sum_overflows_128(cr.credits_pending(), cr.credits_posted()) ||
+      sum_overflows_128(amount, cr.credits_pending() + cr.credits_posted()))
+    return TR_overflows_credits;
+
+  if (sum_overflows_64(t.timestamp, (uint64_t)t.timeout * NS_PER_S))
+    return TR_overflows_timeout;
+
+  // reference: src/tigerbeetle.zig:31-39 balance limit flags
+  if ((dr.flags & A_DR_NOT_EXCEED_CR) &&
+      dr.debits_pending() + dr.debits_posted() + amount > dr.credits_posted())
+    return TR_exceeds_credits;
+  if ((cr.flags & A_CR_NOT_EXCEED_DR) &&
+      cr.credits_pending() + cr.credits_posted() + amount > cr.debits_posted())
+    return TR_exceeds_debits;
+
+  TransferRow t2 = t;
+  t2.set_amount(amount);
+  scope_note_transfer(L, id);
+  L.transfers.insert(id, t2);
+
+  scope_note_account(L, dr_id);
+  scope_note_account(L, cr_id);
+  if (t.flags & T_PENDING) {
+    dr.set_debits_pending(dr.debits_pending() + amount);
+    cr.set_credits_pending(cr.credits_pending() + amount);
+  } else {
+    dr.set_debits_posted(dr.debits_posted() + amount);
+    cr.set_credits_posted(cr.credits_posted() + amount);
+  }
+  L.accounts.rows[L.accounts.find(dr_id)] = dr;
+  L.accounts.rows[L.accounts.find(cr_id)] = cr;
+
+  L.commit_timestamp = t.timestamp;
+  return TR_ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *tb_ledger_new(int acct_slots_log2, int xfer_slots_log2) {
+  Ledger *L = new Ledger();
+  L->accounts.init(acct_slots_log2 > 4 ? acct_slots_log2 : 4);
+  L->transfers.init(xfer_slots_log2 > 4 ? xfer_slots_log2 : 4);
+  L->posted.init(10);
+  return L;
+}
+
+void tb_ledger_free(void *h) { delete (Ledger *)h; }
+
+// Batch executor with linked chains (reference: src/state_machine.zig:
+// 612-698 execute + scopes). op: 128=create_accounts, 129=create_transfers.
+// events: n contiguous 128-byte wire rows. out: n dense u32 result codes.
+// Returns the number of non-ok codes, or -1 on invalid arguments.
+int64_t tb_ledger_execute(void *h, uint8_t op, const uint8_t *events,
+                          uint32_t n, uint64_t timestamp, uint32_t *out) {
+  Ledger &L = *(Ledger *)h;
+  if (op != 128 && op != 129) return -1;
+  int64_t failures = 0;
+  int64_t chain = -1;
+  bool chain_broken = false;
+
+  for (uint32_t index = 0; index < n; index++) {
+    const uint8_t *ev = events + (size_t)index * 128;
+    // Software pipeline: pull the probe lines of a later event's keys
+    // while this one executes (the tables are far larger than cache; the
+    // probes are the only cold misses on the hot path).
+    if (index + 4 < n) {
+      const uint8_t *pv = events + (size_t)(index + 4) * 128;
+      uint64_t plo, phi;
+      memcpy(&plo, pv, 8);
+      memcpy(&phi, pv + 8, 8);
+      if (op == 129) {
+        L.transfers.prefetch(mk128(plo, phi));
+        uint64_t dlo, dhi, clo, chi;
+        memcpy(&dlo, pv + 16, 8);
+        memcpy(&dhi, pv + 24, 8);
+        memcpy(&clo, pv + 32, 8);
+        memcpy(&chi, pv + 40, 8);
+        L.accounts.prefetch(mk128(dlo, dhi));
+        L.accounts.prefetch(mk128(clo, chi));
+      } else {
+        L.accounts.prefetch(mk128(plo, phi));
+      }
+    }
+    uint16_t flags;  // both row layouts: flags @118, timestamp @120
+    memcpy(&flags, ev + 118, 2);
+    uint64_t ev_ts;
+    memcpy(&ev_ts, ev + 120, 8);
+    uint32_t result = UINT32_MAX;  // sentinel: not yet decided
+
+    if (flags & 0x1) {  // linked
+      if (chain < 0) {
+        chain = index;
+        chain_broken = false;
+        L.scope.open = true;
+        L.scope.items.clear();
+      }
+      if (index == n - 1) result = 2;  // linked_event_chain_open
+    }
+    if (result == UINT32_MAX && chain_broken) result = 1;  // linked_event_failed
+    if (result == UINT32_MAX && ev_ts != 0) result = 3;  // timestamp_must_be_zero
+
+    if (result == UINT32_MAX) {
+      uint64_t assigned = timestamp - n + index + 1;
+      if (op == 128) {
+        AccountRow a;
+        memcpy(&a, ev, 128);
+        a.timestamp = assigned;
+        result = create_account(L, a);
+      } else {
+        TransferRow t;
+        memcpy(&t, ev, 128);
+        t.timestamp = assigned;
+        result = create_transfer(L, t);
+      }
+    }
+
+    out[index] = result;
+    if (result != 0) {
+      failures++;
+      if (chain >= 0 && !chain_broken) {
+        chain_broken = true;
+        scope_rollback(L);
+        L.scope.open = false;
+        for (int64_t ci = chain; ci < (int64_t)index; ci++) {
+          if (out[ci] == 0) { out[ci] = 1; failures++; }  // linked_event_failed
+        }
+      }
+    }
+    if (chain >= 0 && (!(flags & 0x1) || result == 2)) {
+      if (!chain_broken) {
+        L.scope.items.clear();  // persist
+        L.scope.open = false;
+      }
+      chain = -1;
+      chain_broken = false;
+    }
+  }
+  return failures;
+}
+
+// Lookups (reference: src/state_machine.zig:701-736): found rows packed in
+// request order, missing skipped. ids: n 16-byte little-endian u128s.
+// Returns found count.
+uint64_t tb_ledger_lookup(void *h, uint8_t op, const uint8_t *ids,
+                          uint32_t n, uint8_t *out_rows) {
+  Ledger &L = *(Ledger *)h;
+  uint64_t found = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    uint64_t lo, hi;
+    memcpy(&lo, ids + (size_t)i * 16, 8);
+    memcpy(&hi, ids + (size_t)i * 16 + 8, 8);
+    u128 id = mk128(lo, hi);
+    if (op == 130) {  // lookup_accounts
+      size_t s = L.accounts.find(id);
+      if (s != NIL) {
+        memcpy(out_rows + found * 128, &L.accounts.rows[s], 128);
+        found++;
+      }
+    } else if (op == 131) {  // lookup_transfers
+      size_t s = L.transfers.find(id);
+      if (s != NIL) {
+        memcpy(out_rows + found * 128, &L.transfers.rows[s], 128);
+        found++;
+      }
+    }
+  }
+  return found;
+}
+
+// counts: [n_accounts, n_transfers, n_posted, commit_timestamp]
+void tb_ledger_counts(void *h, uint64_t *out4) {
+  Ledger &L = *(Ledger *)h;
+  out4[0] = L.accounts.live;
+  out4[1] = L.transfers.live;
+  out4[2] = L.posted.live;
+  out4[3] = L.commit_timestamp;
+}
+
+// --- snapshot / restore (checkpoint blobs) ---
+// Layout: 64-byte header {n_accounts, n_transfers, n_posted,
+// commit_timestamp, acct_cap_log2, xfer_cap_log2, posted_cap_log2,
+// reserved} (all u64) then account rows, transfer rows, posted pairs
+// {ts u64, val u64}. Rows are emitted in TABLE SLOT ORDER and restore
+// recreates the exact capacities, so identical histories — and
+// restore-then-continue — produce byte-identical snapshots (the replica's
+// cross-replica determinism contract).
+
+uint64_t tb_ledger_snapshot_size(void *h) {
+  Ledger &L = *(Ledger *)h;
+  return 64 + (uint64_t)L.accounts.live * 128 +
+         (uint64_t)L.transfers.live * 128 + (uint64_t)L.posted.live * 16;
+}
+
+void tb_ledger_snapshot(void *h, uint8_t *out) {
+  Ledger &L = *(Ledger *)h;
+  uint64_t head[8] = {L.accounts.live, L.transfers.live, L.posted.live,
+                      L.commit_timestamp,
+                      (uint64_t)__builtin_ctzll(L.accounts.rows.size()),
+                      (uint64_t)__builtin_ctzll(L.transfers.rows.size()),
+                      (uint64_t)__builtin_ctzll(L.posted.e.size()), 0};
+  memcpy(out, head, 64);
+  uint8_t *p = out + 64;
+  for (size_t i = 0; i < L.accounts.rows.size(); i++) {
+    if (L.accounts.st[i] == 1) {
+      memcpy(p, &L.accounts.rows[i], 128);
+      p += 128;
+    }
+  }
+  for (size_t i = 0; i < L.transfers.rows.size(); i++) {
+    if (L.transfers.st[i] == 1) {
+      memcpy(p, &L.transfers.rows[i], 128);
+      p += 128;
+    }
+  }
+  for (size_t i = 0; i < L.posted.e.size(); i++) {
+    if (L.posted.e[i].state == 1) {
+      uint64_t pair[2] = {L.posted.e[i].ts, L.posted.e[i].val};
+      memcpy(p, pair, 16);
+      p += 16;
+    }
+  }
+}
+
+int tb_ledger_restore(void *h, const uint8_t *data, uint64_t len) {
+  Ledger &L = *(Ledger *)h;
+  if (len < 64) return -1;
+  uint64_t head[8];
+  memcpy(head, data, 64);
+  uint64_t need = 64 + head[0] * 128 + head[1] * 128 + head[2] * 16;
+  if (len < need) return -1;
+  if (head[4] > 40 || head[5] > 40 || head[6] > 40) return -1;
+  // exact source capacities: slot order (and thus the next snapshot's
+  // bytes) reproduces across restore
+  L.accounts.init(head[4]);
+  L.transfers.init(head[5]);
+  L.posted.init(head[6]);
+  L.commit_timestamp = head[3];
+  const uint8_t *p = data + 64;
+  for (uint64_t i = 0; i < head[0]; i++) {
+    AccountRow a;
+    memcpy(&a, p, 128);
+    p += 128;
+    L.accounts.insert(a.id(), a);
+  }
+  for (uint64_t i = 0; i < head[1]; i++) {
+    TransferRow t;
+    memcpy(&t, p, 128);
+    p += 128;
+    L.transfers.insert(t.id(), t);
+  }
+  for (uint64_t i = 0; i < head[2]; i++) {
+    uint64_t pair[2];
+    memcpy(pair, p, 16);
+    p += 16;
+    L.posted.insert(pair[0], (uint8_t)pair[1]);
+  }
+  return 0;
+}
+
+}  // extern "C"

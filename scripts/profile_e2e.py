@@ -1,0 +1,38 @@
+"""Profile the durable e2e path: run the real server under cProfile and
+print the top costs of the event loop (where the 62k-TPS ceiling lives).
+
+Usage: python scripts/profile_e2e.py [n_transfers]
+"""
+
+import os
+import pstats
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tigerbeetle_tpu.benchmark import run_e2e  # noqa: E402
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 300_000
+    prof_path = os.path.join(tempfile.gettempdir(), "tb_e2e_server.pstats")
+    os.environ["TB_PROFILE"] = prof_path
+    result = run_e2e(
+        n_accounts=10_000,
+        n_transfers=n,
+        clients=int(os.environ.get("E2E_CLIENTS", "16")),
+        log=lambda *a: print("[e2e]", *a, file=sys.stderr),
+    )
+    print(result)
+    stats = pstats.Stats(prof_path)
+    stats.sort_stats("cumulative")
+    print("\n==== cumulative ====")
+    stats.print_stats(35)
+    stats.sort_stats("tottime")
+    print("\n==== tottime ====")
+    stats.print_stats(35)
+
+
+if __name__ == "__main__":
+    main()

@@ -92,12 +92,16 @@ def _transfer_row(toks: list[str]) -> tuple[Transfer, str]:
     return t, toks[19]
 
 
-def run_table(table: str, device: bool = False) -> None:
+def run_table(table: str, device: bool = False, backend=None) -> None:
     """Replay one reference test table. With device=True the ledger under
     test is the TPU kernel stack (oracle still drives lookups of raw state
-    expectations)."""
+    expectations); `backend` swaps in any other ledger backend with the
+    same duck-typed API (the native C++ engine)."""
     oracle = OracleStateMachine()
-    dev = DeviceLedger(process=TEST_PROCESS, mode="auto") if device else None
+    if backend is not None:
+        dev = backend()
+    else:
+        dev = DeviceLedger(process=TEST_PROCESS, mode="auto") if device else None
 
     pending: list = []
     expected: list[str] = []
@@ -708,3 +712,12 @@ def test_golden_oracle(name):
 @pytest.mark.parametrize("name", DEVICE_TABLES)
 def test_golden_device(name):
     run_table(ORACLE_TABLES[name], device=True)
+
+
+@pytest.mark.parametrize("name", DEVICE_TABLES)
+def test_golden_native(name):
+    """The native C++ engine replays the reference's own test tables with
+    bit-exact result codes (native/ledger.cc parity contract)."""
+    from tigerbeetle_tpu.models.native_ledger import NativeLedger
+
+    run_table(ORACLE_TABLES[name], backend=lambda: NativeLedger(10, 10))

@@ -1,0 +1,111 @@
+"""Async-WAL crash recovery on a single replica: out-of-order prepare
+writes + chain validation at open (ADVICE round-3 high finding).
+
+With commit_window > 0 the single-replica primary writes prepares through
+an 8-worker pool (vsr/journal.py), so op N+1's 1 MiB write can land while
+op N's is still in flight. A crash in that window leaves a GAP below a
+durable higher-op prepare. Recovery must treat the chain as ending at the
+gap (no reply can have left for anything above it: replies finalize in op
+order, each awaiting its own WAL future), and must DESTROY the stale
+higher slots — otherwise a restart that re-fills the gap on a new timeline
+leaves a slot that breaks the hash chain and crash-loops the SECOND
+restart (reference: src/vsr/journal.zig:374-535 classifies such slots in
+its recovery decision matrix).
+"""
+
+import numpy as np
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.header import Command, Header
+
+
+def _accounts_body(ids):
+    return types.accounts_to_np(
+        [types.Account(id=i, ledger=1, code=1) for i in ids]
+    ).tobytes()
+
+
+def _craft_prepare(replica, op, parent, timestamp, body):
+    h = Header(
+        command=int(Command.prepare),
+        operation=int(Operation.create_accounts),
+        op=op,
+        parent=parent,
+        timestamp=timestamp,
+        view=replica.view,
+        replica=replica.replica,
+    )
+    h.set_checksum_body(body)
+    h.set_checksum()
+    return h
+
+
+def test_gap_below_durable_higher_prepare_truncates_and_survives_refill():
+    cluster = Cluster(replica_count=1)
+    r = cluster.replicas[0]
+    client = cluster.add_client()
+    cluster.execute(client, Operation.create_accounts, _accounts_body([1, 2]))
+    base = r.op
+    base_checksum = r.parent_checksum
+    ts = r.sm.prepare_timestamp
+
+    # The crash window: op base+1's WAL write was still queued (nothing on
+    # disk), op base+2's landed. Craft both prepares on the pre-crash
+    # timeline; only the higher one reaches the journal.
+    b1 = _accounts_body([100])
+    h1 = _craft_prepare(r, base + 1, base_checksum, ts + 10, b1)
+    b2 = _accounts_body([101])
+    h2 = _craft_prepare(r, base + 2, h1.checksum, ts + 20, b2)
+    r.journal.write_prepare(h2, b2)  # out-of-order landing
+    r.journal.quiesce()
+
+    # Restart 1: recovery stops at the gap; the stale higher slot must be
+    # destroyed (it was never acked — replies finalize in op order).
+    r1 = cluster.restart_replica(0)
+    assert r1.op == base and r1.commit_min == base
+    assert r1.journal.read_prepare(base + 2) is None, (
+        "stale-timeline slot above the gap survived recovery"
+    )
+
+    # New timeline: re-fill ONLY base+1 (one register op) so a surviving
+    # stale base+2 slot would sit right above the new head at restart 2.
+    client2 = cluster.add_client()  # register consumes exactly base+1
+    committed = r1.commit_min
+    assert committed == base + 1
+
+    # Restart 2: previously crash-looped on `assert header.parent` against
+    # the stale base+2 slot; now replays the new timeline cleanly.
+    r2 = cluster.restart_replica(0)
+    assert r2.commit_min == committed
+    assert r2.op == committed
+
+    # and the replica still serves
+    client3 = cluster.add_client()
+    _h, reply = cluster.execute(
+        client3, Operation.create_accounts, _accounts_body([300])
+    )
+    assert reply == b""
+
+
+def test_mid_log_chain_break_truncates_at_break():
+    """A surviving higher slot whose parent does NOT chain from the replay
+    head must end the replay (not assert): ops above it are a stale
+    timeline."""
+    cluster = Cluster(replica_count=1)
+    r = cluster.replicas[0]
+    client = cluster.add_client()
+    cluster.execute(client, Operation.create_accounts, _accounts_body([1]))
+    base = r.op
+    ts = r.sm.prepare_timestamp
+
+    # A prepare for base+1 whose parent checksum is junk (stale timeline).
+    b1 = _accounts_body([110])
+    h1 = _craft_prepare(r, base + 1, 0xDEADBEEF, ts + 10, b1)
+    r.journal.write_prepare(h1, b1)
+    r.journal.quiesce()
+
+    r1 = cluster.restart_replica(0)
+    assert r1.op == base and r1.commit_min == base
+    assert r1.journal.read_prepare(base + 1) is None

@@ -114,6 +114,7 @@ def run_e2e(
     jax_platform: str | None = None,
     tmpdir: str | None = None,
     server_args: tuple[str, ...] = (),
+    backend: str = "native",
     log=None,
 ) -> dict:
     """Format, start a real replica, drive the protocol, return metrics.
@@ -151,6 +152,7 @@ def run_e2e(
          "--addresses", f"127.0.0.1:{port}",
          "--account-slots-log2", str(acct_log2),
          "--transfer-slots-log2", str(slots_log2),
+         "--backend", backend,
          *server_args, path],
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -167,19 +169,52 @@ def run_e2e(
 
         # Keep draining server output: an unread pipe fills and BLOCKS the
         # server's next print (debug mode would wedge the whole benchmark).
-        def _drain_stdout():
-            for out in proc.stdout:
-                log("[server]", out.rstrip())
+        server_stats: dict = {}
 
-        threading.Thread(target=_drain_stdout, daemon=True).start()
-        return _drive(
+        def _drain_stdout():
+            import json as _json
+
+            for out in proc.stdout:
+                line = out.rstrip()
+                if line.startswith("[stats] "):
+                    try:
+                        server_stats.update(_json.loads(line[8:]))
+                    except ValueError:
+                        pass
+                log("[server]", line)
+
+        drain_thread = threading.Thread(target=_drain_stdout, daemon=True)
+        drain_thread.start()
+        result = _drive(
             proc, port, n_accounts, n_transfers, batch, clients,
             warmup_batches, log,
         )
+        # SIGTERM makes the server emit its [stats] line (group-commit hit
+        # rate etc.); after exit the pipe hits EOF, so joining the drain
+        # thread is deterministic (no sleep race).
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        drain_thread.join(timeout=5)
+        if server_stats:
+            result["server_stats"] = server_stats
+            g = server_stats.get("group", {})
+            total = g.get("fused_ops", 0) + g.get("solo_ops", 0)
+            if total:
+                result["group_commit_hit_rate"] = round(
+                    g.get("fused_ops", 0) / total, 4
+                )
+        return result
     finally:
         if proc.poll() is None:
-            proc.kill()
-            proc.wait()
+            proc.terminate()  # SIGTERM first: lets a profiling run dump
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
         if own_tmp:
             tmp.cleanup()
 

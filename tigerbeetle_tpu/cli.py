@@ -49,6 +49,12 @@ class StartArgs:
     aof: str = ""  # append-only disaster-recovery log path
     statsd: str = ""  # statsd host:port
     commit_window: int = 8  # async device commits in flight (0 = sync)
+    # Commit backend: "native" = the C++ host engine (native/ledger.cc —
+    # the durable hot path; this environment's tunneled TPU degrades
+    # permanently on any device->host fetch, see models/native_ledger.py),
+    # "device" = the JAX DeviceLedger (the TPU compute path; supports
+    # HBM->LSM spill + sharding).
+    backend: str = "native"
 
 
 @dataclasses.dataclass
@@ -123,9 +129,18 @@ def cmd_start(args) -> int:
     boot("storage open")
     bus = TCPMessageBus(addresses, args.replica, listen=True)
     boot("bus bound")  # must not contain "listening": spawners match on it
+    backend_factory = None
+    if args.backend == "native":
+        from tigerbeetle_tpu.models.native_ledger import NativeLedger
+
+        backend_factory = lambda: NativeLedger(  # noqa: E731
+            args.account_slots_log2, args.transfer_slots_log2
+        )
+    elif args.backend != "device":
+        flags.fatal(f"unknown --backend {args.backend!r} (native|device)")
     replica = Replica(
         args.replica, len(addresses), storage, bus, RealTime(),
-        cluster_cfg, process_cfg,
+        cluster_cfg, process_cfg, backend_factory=backend_factory,
     )
     boot("replica constructed (device state allocated)")
     if args.aof:
@@ -144,6 +159,38 @@ def cmd_start(args) -> int:
         f"(op={replica.op}, commit={replica.commit_min})",
         flush=True,
     )
+    profile_path = os.environ.get("TB_PROFILE")
+    prof = None
+    if profile_path:
+        # Profile the event loop; dump pstats on SIGTERM (the bench harness
+        # terminates the server when the drive completes).
+        import cProfile
+
+        prof = cProfile.Profile()
+
+    def _on_term(_sig, _frm):
+        # Emit observability counters for the bench harness (group-commit
+        # hit rate etc.), then exit. The harness parses the [stats] line.
+        import json as _json
+
+        hz = getattr(replica.ledger, "hazards", None)
+        stats = {
+            "group": replica.group_stats,
+            "split": dict(hz.split_stats) if hz is not None else {},
+            "pool_dropped": bus.pool.dropped,
+        }
+        if getattr(replica.ledger, "spill", None) is not None:
+            stats["spill"] = dict(replica.ledger.spill.stats)
+        print(f"[stats] {_json.dumps(stats)}", flush=True)
+        if prof is not None:
+            prof.disable()
+            prof.dump_stats(profile_path)
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    if prof is not None:
+        prof.enable()
+
     debug = bool(os.environ.get("TB_DEBUG"))
     tick_s = process_cfg.tick_ms / 1000.0
     last_tick = time.monotonic()

@@ -1536,20 +1536,33 @@ class PendingGroup:
     """One fused device dispatch covering several batches (group commit):
     a single flat results array [k * n_pad + 1] (last word = fault),
     fetched ONCE for the whole group — the per-batch launch + transfer
-    latency that dominates a high-latency transport is paid 1/k times."""
+    latency that dominates a high-latency transport is paid 1/k times.
 
-    __slots__ = ("results", "n_pad", "k", "host")
+    `summary` [k + 1] = per-slot failure counts + fault word, computed on
+    device: the all-success steady state fetches THESE few words per group
+    and never materializes the dense codes at all (the reply body for
+    all-ok is empty; reference: src/tigerbeetle.zig:231-249 sparse
+    results)."""
 
-    def __init__(self, results, n_pad: int, k: int):
+    __slots__ = ("results", "n_pad", "k", "host", "summary", "host_summary")
+
+    def __init__(self, results, n_pad: int, k: int, summary=None):
         self.results = results
         self.n_pad = n_pad
         self.k = k
         self.host = None
+        self.summary = summary
+        self.host_summary = None
 
     def fetch(self):
         if self.host is None:
             self.host = np.asarray(self.results)
         return self.host
+
+    def fetch_summary(self):
+        if self.host_summary is None:
+            self.host_summary = np.asarray(self.summary)
+        return self.host_summary
 
 
 class PendingBatch:
@@ -1559,10 +1572,11 @@ class PendingBatch:
     src/vsr/replica.zig:5102-5186, pipeline_prepare_queue_max=8)."""
 
     __slots__ = ("operation", "n", "results", "flags", "id_limbs", "dense",
-                 "epoch", "group", "group_idx")
+                 "epoch", "group", "group_idx", "summary", "failures",
+                 "codes_np")
 
     def __init__(self, operation, n, results, flags=None, id_limbs=None,
-                 epoch=0, group=None, group_idx=0):
+                 epoch=0, group=None, group_idx=0, summary=None):
         self.operation = operation
         self.n = n
         self.results = results  # device u32 [n_pad + 1]; last = fault word
@@ -1572,6 +1586,9 @@ class PendingBatch:
         self.epoch = epoch  # occupancy epoch at dispatch (spill reconcile)
         self.group = group  # PendingGroup when part of a fused dispatch
         self.group_idx = group_idx  # this batch's row within the group
+        self.summary = summary  # device [count, fault]: the cheap drain
+        self.failures = None  # failure count once drained
+        self.codes_np = None  # dense codes np array (failure path only)
 
 
 class DeviceLedger(HostLedgerBase):
@@ -1699,26 +1716,42 @@ class DeviceLedger(HostLedgerBase):
             self._acct_used += n
         else:
             raise AssertionError(operation)
-        # Pack the fault word onto the results and START the device->host
-        # copy now: drain() then reads an already-landed buffer instead of
-        # paying three synchronous round trips (block + results + fault) —
-        # on a high-latency transport each costs ~100 ms, which would
-        # dominate the whole durable commit path.
-        results = jnp.concatenate(
-            [
-                results.astype(jnp.uint32),
-                self.state["fault"].reshape(1).astype(jnp.uint32),
-            ]
+        # Pack the fault word onto the results, compute the device-side
+        # failure count, and START the summary's device->host copy now:
+        # the all-success steady state drains TWO words per batch (count +
+        # fault) off an already-landed buffer — no dense-codes transfer, no
+        # per-event host loop, no sync round trip.
+        results, summary = self._summarize_fn()(
+            results, self.state["fault"], nn
         )
         if self.prefetch_results:
             try:
-                results.copy_to_host_async()
+                summary.copy_to_host_async()
             except (AttributeError, RuntimeError):
                 pass  # no async copy: drain pays the sync cost
         return PendingBatch(
             operation, n, results, flags=arr["flags"].copy(),
-            epoch=self._occupancy_epoch,
+            epoch=self._occupancy_epoch, summary=summary,
         )
+
+    def _summarize_fn(self):
+        """Jitted (results, fault, n) -> (packed results+fault, [count,
+        fault]): ONE dispatch for the post-kernel bookkeeping (the previous
+        out-of-jit concatenate was its own XLA launch per batch)."""
+        fn = getattr(self, "_summarize_cache", None)
+        if fn is None:
+            def s(results, fault, n):
+                res = results.astype(jnp.uint32)
+                lane = jnp.arange(res.shape[0], dtype=jnp.int32)
+                cnt = jnp.sum(
+                    ((res != 0) & (lane < n)).astype(jnp.uint32)
+                )
+                f = fault.reshape(1).astype(jnp.uint32)
+                packed = jnp.concatenate([res, f])
+                return packed, jnp.concatenate([cnt.reshape(1), f])
+
+            fn = self._summarize_cache = jax.jit(s)
+        return fn
 
     def _execute_split(self, arr, n, n_pad, nn, ts, timestamp: int, slow_mask,
                        fast_mode: str = "fast"):
@@ -1777,13 +1810,21 @@ class DeviceLedger(HostLedgerBase):
                     st, res = kernels._commit_transfers(
                         st, {"rows": r}, n, t, mode="fast"
                     )
-                    return st, res.astype(jnp.uint32)
+                    res = res.astype(jnp.uint32)
+                    lane = jnp.arange(res.shape[0], dtype=jnp.int32)
+                    cnt = jnp.sum(
+                        ((res != 0) & (lane < n)).astype(jnp.uint32)
+                    )
+                    return st, (res, cnt)
 
-                state, results = jax.lax.scan(body, state, (rows, ns, tss))
-                return state, jnp.concatenate([
-                    results.reshape(-1),
-                    state["fault"].reshape(1).astype(jnp.uint32),
-                ])
+                state, (results, cnts) = jax.lax.scan(
+                    body, state, (rows, ns, tss)
+                )
+                fault = state["fault"].reshape(1).astype(jnp.uint32)
+                flat = jnp.concatenate([results.reshape(-1), fault])
+                # summary = per-slot failure counts + fault: the only words
+                # the all-success drain ever transfers
+                return state, flat, jnp.concatenate([cnts, fault])
 
             fn = cache[(k, n_pad)] = jax.jit(step, donate_argnums=(0,))
         return fn
@@ -1824,7 +1865,7 @@ class DeviceLedger(HostLedgerBase):
             ns[i] = len(arr)
             tss[i] = ts
         try:
-            state, flat = self._group_stepper(k, n_pad)(
+            state, flat, summary = self._group_stepper(k, n_pad)(
                 self.state, jnp.asarray(rows), jnp.asarray(ns),
                 jnp.asarray(tss),
             )
@@ -1843,11 +1884,11 @@ class DeviceLedger(HostLedgerBase):
             self.hazards.note_pending(arr)
         if self.prefetch_results:
             try:
-                flat.copy_to_host_async()
+                summary.copy_to_host_async()
             except (AttributeError, RuntimeError):
                 pass
         self._xfer_used += total
-        group = PendingGroup(flat, n_pad, k)
+        group = PendingGroup(flat, n_pad, k, summary=summary)
         return [
             PendingBatch(
                 Operation.create_transfers, len(arr), flat,
@@ -1867,17 +1908,50 @@ class DeviceLedger(HostLedgerBase):
         conservative occupancy charge to the exact ever-applied insert count
         (rolled-back inserts leave tombstones, which still occupy probe
         slots — see applied_insert_mask). Idempotent: a second drain returns
-        the cached codes without double-reconciling."""
+        the cached codes without double-reconciling.
+
+        Fast path: the device-side summary (failure count + fault word —
+        a few words, prefetched at dispatch) proves the batch all-success,
+        in which case every event applied (applied == n, reconcile is a
+        no-op) and the dense codes are all zeros — no codes transfer, no
+        per-event host loop."""
         if pending.dense is not None:
             return pending.dense
         if pending.group is not None:
             g = pending.group
+            if g.summary is not None:
+                s = g.fetch_summary()  # [k counts..., fault]: a few words
+                fault = int(s[-1])
+                if int(s[pending.group_idx]) == 0:
+                    return self._drain_all_ok(pending, fault)
             arr = g.fetch()  # one transfer for the whole group (cached)
             off = pending.group_idx * g.n_pad
             codes = arr[off : off + pending.n]
             return self._drain_from_host(pending, codes, int(arr[-1]))
+        if pending.summary is not None:
+            s = np.asarray(pending.summary)  # [count, fault]
+            if int(s[0]) == 0:
+                return self._drain_all_ok(pending, int(s[1]))
         arr = np.asarray(pending.results)  # one transfer: results + fault
         return self._drain_from_host(pending, arr[: pending.n], int(arr[-1]))
+
+    def _drain_all_ok(self, pending: PendingBatch, fault: int) -> list[int]:
+        raise_on_fault(fault, "device ledger")
+        pending.failures = 0
+        pending.dense = [0] * pending.n
+        return pending.dense
+
+    def drain_reply(self, pending: PendingBatch, operation) -> bytes:
+        """The reply body bytes (sparse non-ok result structs, reference:
+        src/tigerbeetle.zig:231-249) without any per-event Python loop:
+        all-success replies are empty by construction, and the failure path
+        encodes via vectorized nonzero."""
+        self.drain(pending)
+        if not pending.failures:
+            return b""
+        from tigerbeetle_tpu.state_machine import encode_sparse_results
+
+        return encode_sparse_results(pending.codes_np, operation)
 
     def drain_many(self, pendings) -> None:
         """Materialize a window of pending batches. Each batch's
@@ -1894,6 +1968,8 @@ class DeviceLedger(HostLedgerBase):
     def _drain_from_host(self, pending: PendingBatch, codes,
                          fault: int) -> list[int]:
         raise_on_fault(fault, "device ledger")
+        pending.codes_np = np.asarray(codes, dtype=np.uint32)
+        pending.failures = int(np.count_nonzero(pending.codes_np))
         dense = [int(x) for x in codes]
         applied = int(applied_insert_mask(dense, pending.flags).sum())
         if pending.operation == Operation.create_transfers:

@@ -24,7 +24,7 @@ _lib: ctypes.CDLL | None = None
 def _build() -> None:
     srcs = [
         os.path.join(_NATIVE_DIR, s)
-        for s in ("aegis.cc", "storage.cc", "tb_client.cc")
+        for s in ("aegis.cc", "storage.cc", "tb_client.cc", "ledger.cc")
     ]
     if os.path.exists(_LIB_PATH) and all(
         os.path.getmtime(_LIB_PATH) >= os.path.getmtime(s) for s in srcs
@@ -59,6 +59,31 @@ def lib() -> ctypes.CDLL:
                 fn.restype = ctypes.c_int
             l.tb_storage_sync.argtypes = [ctypes.c_int]
             l.tb_storage_sync.restype = ctypes.c_int
+            # native ledger engine (native/ledger.cc)
+            l.tb_ledger_new.argtypes = [ctypes.c_int, ctypes.c_int]
+            l.tb_ledger_new.restype = ctypes.c_void_p
+            l.tb_ledger_free.argtypes = [ctypes.c_void_p]
+            l.tb_ledger_free.restype = None
+            l.tb_ledger_execute.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint8, ctypes.c_char_p,
+                ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p,
+            ]
+            l.tb_ledger_execute.restype = ctypes.c_int64
+            l.tb_ledger_lookup.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint8, ctypes.c_char_p,
+                ctypes.c_uint32, ctypes.c_void_p,
+            ]
+            l.tb_ledger_lookup.restype = ctypes.c_uint64
+            l.tb_ledger_counts.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            l.tb_ledger_counts.restype = None
+            l.tb_ledger_snapshot_size.argtypes = [ctypes.c_void_p]
+            l.tb_ledger_snapshot_size.restype = ctypes.c_uint64
+            l.tb_ledger_snapshot.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            l.tb_ledger_snapshot.restype = None
+            l.tb_ledger_restore.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64
+            ]
+            l.tb_ledger_restore.restype = ctypes.c_int
             _lib = l
     return _lib
 

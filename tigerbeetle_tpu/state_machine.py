@@ -59,6 +59,17 @@ def encode_results(sparse: list[tuple[int, int]], operation: Operation) -> bytes
     return out.tobytes()
 
 
+def encode_sparse_results(codes: np.ndarray, operation: Operation) -> bytes:
+    """Dense u32 codes -> sparse non-ok reply body, vectorized (reference:
+    src/tigerbeetle.zig:231-249). Shared by the device and native
+    backends' drain_reply."""
+    idx = np.nonzero(codes)[0]
+    out = np.zeros(len(idx), dtype=_RESULT_DTYPES[operation])
+    out["index"] = idx.astype(np.uint32)
+    out["result"] = codes[idx]
+    return out.tobytes()
+
+
 def decode_results(body: bytes, operation: Operation) -> list[tuple[int, int]]:
     assert len(body) % RESULT_SIZE == 0, len(body)
     arr = np.frombuffer(body, dtype=_RESULT_DTYPES[operation])
@@ -159,11 +170,15 @@ class StateMachine:
             self.backend, "execute_async"
         ):
             return self.commit(operation, timestamp, body)  # reads / oracle
-        events = (
-            decode_accounts(body)
-            if operation == Operation.create_accounts
-            else decode_transfers(body)
-        )
+        if getattr(self.backend, "zero_copy_events", False):
+            # backend only reads the rows: skip the 1 MiB defensive copy
+            events = np.frombuffer(body, dtype=_EVENT_DTYPES[operation])
+        else:
+            events = (
+                decode_accounts(body)
+                if operation == Operation.create_accounts
+                else decode_transfers(body)
+            )
         return (operation, self.backend.execute_async(operation, timestamp, events))
 
     def commit_group_async(self, operation: Operation, batches):
@@ -175,7 +190,12 @@ class StateMachine:
             return None
         if not hasattr(self.backend, "try_execute_group_async"):
             return None
-        items = [(ts, decode_transfers(body)) for ts, body in batches]
+        # read-only views (no 1 MiB copy per batch): the group path only
+        # reads the rows into the staging buffer
+        items = [
+            (ts, np.frombuffer(body, dtype=TRANSFER_DTYPE))
+            for ts, body in batches
+        ]
         pendings = self.backend.try_execute_group_async(items)
         if pendings is None:
             return None
@@ -194,6 +214,10 @@ class StateMachine:
         if isinstance(handle, bytes):
             return handle
         operation, pending = handle
+        if hasattr(self.backend, "drain_reply"):
+            # vectorized sparse encoding; empty for all-success without
+            # materializing dense codes at all
+            return self.backend.drain_reply(pending, operation)
         dense = self.backend.drain(pending)
         return encode_results(
             [(i, c) for i, c in enumerate(dense) if c], operation

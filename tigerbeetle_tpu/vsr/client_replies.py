@@ -29,9 +29,14 @@ class ClientReplies:
         self.slot_count = cluster.clients_max
 
     def write(self, slot: int, wire: bytes) -> None:
+        """Best-effort persistence (write_lazy): a reply lost to a crash
+        before the next sync reads as absent (checksum mismatch) and the
+        reply-lost fallbacks apply; the checkpoint chain syncs before
+        persisting the client table, so a checkpointed reply_checksum
+        always has durable bytes behind it."""
         assert 0 <= slot < self.slot_count
         assert len(wire) <= self.slot_size
-        self.storage.write(Zone.client_replies, slot * self.slot_size, wire)
+        self.storage.write_lazy(Zone.client_replies, slot * self.slot_size, wire)
 
     def read(self, slot: int, checksum: int) -> bytes | None:
         """The slot's reply wire bytes iff intact and matching `checksum`

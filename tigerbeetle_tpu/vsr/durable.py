@@ -178,9 +178,14 @@ def restore_from_snapshot(
 ) -> None:
     """Load a checkpoint back into the ledger backend (inverse of
     snapshot_to_superblock; fresh state when the superblock has no blobs)."""
-    if not hasattr(ledger, "state"):  # oracle backend
+    if not hasattr(ledger, "state"):  # oracle/native backend
         for ref in state.blobs:
-            assert ref.name == "oracle", ref.name
+            if ref.name != "oracle":
+                raise RuntimeError(
+                    f"checkpoint blob {ref.name!r} was written by the DEVICE "
+                    "backend; this replica is running the native/oracle "
+                    "backend — restart with --backend device (or re-format)"
+                )
             raw = storage.read(Zone.grid, ref.offset, ref.size)
             if native.checksum(raw) != ref.checksum:
                 raise RuntimeError(f"snapshot blob {ref.name}: bad checksum")
@@ -193,6 +198,12 @@ def restore_from_snapshot(
     dev = init_state(process)
     if state.blobs:
         for ref in state.blobs:
+            if ref.name == "oracle":
+                raise RuntimeError(
+                    "checkpoint blob was written by the native/oracle "
+                    "backend; this replica is running the DEVICE backend — "
+                    "restart with --backend native (or re-format)"
+                )
             raw = storage.read(Zone.grid, ref.offset, ref.size)
             if native.checksum(raw) != ref.checksum:
                 raise RuntimeError(f"snapshot blob {ref.name}: bad checksum")

@@ -118,7 +118,19 @@ class Journal:
             self._io_executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="journal-io"
             )
-        return self._io_executor.submit(fn, *args)
+            self._pending_io: set[Future] = set()
+        fut = self._io_executor.submit(fn, *args)
+        self._pending_io.add(fut)
+        fut.add_done_callback(self._pending_io.discard)
+        return fut
+
+    def drain_io(self) -> None:
+        """Wait for queued background IO. The checkpoint chain must call
+        this before persisting the client table: a recorded reply_checksum
+        whose slot write never landed would wedge that session forever
+        (duplicate requests dropped, reply unreadable)."""
+        for fut in list(getattr(self, "_pending_io", ())):
+            fut.result()
 
     def _write_task(self, slot: int, sector: int, wire: bytes) -> None:
         # prepare FIRST, then the redundant header (same ordering contract

@@ -167,6 +167,9 @@ class Replica:
         # (reference: src/vsr/grid_blocks_missing.zig)
         self._grid_missing: set[int] = set()
         self._scrub_cursor = 0
+        # group-commit observability (BENCH reports the hit rate): ops
+        # committed via a fused device dispatch vs per-op fallback
+        self.group_stats = {"fused_ops": 0, "solo_ops": 0}
         # test/simulator observation hook: called on every committed prepare
         self.commit_hook = None
         # optional append-only disaster-recovery log (reference: src/aof.zig,
@@ -230,7 +233,15 @@ class Replica:
         op = state.commit_min + 1
         while op in recovered:
             header, body = self.journal.read_prepare(op)  # type: ignore
-            assert header.parent == self.parent_checksum
+            if header.parent != self.parent_checksum:
+                # Stale-timeline slot: a crash between OUT-OF-ORDER async
+                # WAL writes (write N lost, write N+1 landed) leaves a gap;
+                # after restart re-fills the gap on a new timeline, the
+                # surviving higher slot no longer chains. No reply can have
+                # left for it (replies finalize in op order, each waiting
+                # its own WAL future), so the chain — and durability —
+                # ends at the last op that chains.
+                break
             if self.replica_count == 1 and not self.standby:
                 # Single replica: every journaled op was committed (WAL is
                 # written before execution, and there is no one else).
@@ -244,6 +255,15 @@ class Replica:
             self.op = op
             self.parent_checksum = header.checksum
             op += 1
+        if self.replica_count == 1 and not self.standby:
+            # Destroy journal evidence above the replay head: slots beyond a
+            # gap or chain break are unreachable stale timelines (never
+            # acked — see the ordering argument above), and left in place
+            # they would be re-filled piecemeal and crash-loop a SECOND
+            # restart on the broken chain. Multi-replica keeps its tail:
+            # acked prepares above a torn slot are DVC evidence that
+            # protocol-aware recovery needs (adoption truncates instead).
+            self.journal.invalidate_above(self.op)
         genesis = state.sequence == 1 and self.op == 0
         if self.replica_count == 1 or genesis:
             # Cold boot of a fresh cluster (or single replica): view 0 with
@@ -274,6 +294,12 @@ class Replica:
         table rides in the snapshot meta — it is part of the replicated
         state (reference: src/vsr/superblock.zig ClientSessions trailer)."""
         self.flush_commits()  # snapshot sees finalized client-table state
+        # Queued reply-slot writes must land before the client table (with
+        # their checksums) is persisted: a crash after the superblock commit
+        # but before a queued write would record a reply_checksum for bytes
+        # that never hit disk — that session's duplicate requests would be
+        # dropped forever (reply absent, request number already recorded).
+        self.journal.drain_io()
         table = {
             str(c): {
                 "session": e["session"],
@@ -602,7 +628,11 @@ class Replica:
             cluster=self.superblock.state.cluster if self.superblock.state else 0,
             replica=self.replica,
         )
-        prepare.set_checksum_body(body)
+        # The prepare's body IS the request's body: reuse the checksum the
+        # request carried (verified on receive) instead of re-hashing the
+        # full 1 MiB per prepare.
+        prepare.size = HEADER_SIZE + len(body)
+        prepare.checksum_body = header.checksum_body
         prepare.set_checksum()
         if self.commit_window > 0 and self.replica_count == 1:
             # async WAL (reference: journal write IOPS): the reply waits
@@ -621,7 +651,12 @@ class Replica:
         self.parent_checksum = prepare.checksum
         self.pipeline[op] = {"header": prepare, "body": body,
                              "oks": {self.replica}, "wal": wal}
-        for r in range(self.replica_count):
+        # Stream prepares to standbys too (they journal + commit but never
+        # ack — _ack_prepare declines): without this a standby would learn
+        # each op only via a commit heartbeat plus one request_prepare round
+        # trip, lagging unboundedly under sustained load (the reference
+        # streams prepares to standbys).
+        for r in range(self.replica_count + self.standby_count):
             if r != self.replica:
                 self.network.send(self.replica, r, prepare.to_bytes() + body)
         if self.commit_window > 0 and self.replica_count == 1:
@@ -1104,6 +1139,7 @@ class Replica:
                     d = self._commit_dispatch(header, body)
                     d["wal"] = entry.get("wal")
                     self._inflight.append(d)
+                    self.group_stats["solo_ops"] += 1
                     self.flush_commits(keep=self.commit_window)
                 else:
                     reply_wire = self._commit_prepare(header, body)
@@ -1160,6 +1196,7 @@ class Replica:
             self.commit_min = self.commit_max = h.op
             self.commit_checksum = h.checksum
             del self.pipeline[h.op]
+        self.group_stats["fused_ops"] += len(run)
         self.flush_commits(keep=self.commit_window)
         return True
 
@@ -1381,7 +1418,15 @@ class Replica:
         h = self._inflight[-1]["handle"]
         if h is None or isinstance(h, bytes):
             return True
-        is_ready = getattr(h[1].results, "is_ready", None)
+        p = h[1]
+        if hasattr(p, "is_ready"):  # native pending: probes itself
+            return bool(p.is_ready())
+        probe = getattr(p, "summary", None)
+        if probe is None and getattr(p, "group", None) is not None:
+            probe = p.group.summary
+        if probe is None:
+            probe = p.results
+        is_ready = getattr(probe, "is_ready", None)
         return bool(is_ready()) if is_ready is not None else True
 
     # ------------------------------------------------------------------
