@@ -6,27 +6,36 @@ Reference protocol (reference: src/benchmark.zig:23-73, scripts/benchmark.sh):
 amount=1), measure transfers/s and batch-latency percentiles
 p00/p25/p50/p75/p100 (reference: src/benchmark.zig main loop printout).
 
-Two measured paths, both the full commit kernel (validation ladders, account
-lookups, claim inserts, balance application — models/ledger.py fast tier):
+Measured paths:
 
+- **Durable (the BASELINE protocol)**: a REAL replica process (WAL +
+  consensus + TCP session clients at batch=8190), conservation verified
+  over the wire. The commit engine is the native C++ host ledger
+  (native/ledger.cc) — on this environment's tunneled TPU, ANY
+  device->host fetch permanently degrades the transport (dispatch ~30us ->
+  ~12ms, h2d 140+ MiB/s -> ~14 MiB/s; measured, see ops/hashtable.py and
+  models/native_ledger.py), so a reply-serving server cannot run its hot
+  loop through the device. A short device-backend durable run is reported
+  separately (durable_device_tps) as the honest through-stack TPU number,
+  plus a two-phase-heavy durable run (durable_two_phase_tps).
 - **Flagship (device-generated ingest)**: the protocol workload is generated
   ON DEVICE from a seeded PRNG (same distribution: reversed sequential ids,
   uniform random distinct account pairs, amount=1) and committed batch by
-  batch, K batches fused per dispatch. This measures the state machine's
-  commit throughput the way the reference's loopback benchmark does — its
-  client and replica share a machine, so message transport is never the
-  bottleneck there. Here the TPU hangs off a ~143 MiB/s tunnel (measured),
-  so shipping 128 B/transfer from host would cap ANY kernel at ~1.17M
-  transfers/s — an environment artifact, not a property of the design.
+  batch, K batches fused per dispatch — the TPU commit kernel's throughput,
+  the way the reference's loopback benchmark measures its state machine.
+  Median of 5 timed segments with the per-run values reported.
 - **Ingest-limited (host-upload)**: batches built on host and uploaded
   per-batch (1 MiB each), pipelined, no d2h until the clock stops. Reported
-  as `ingest_tps` alongside the flagship number.
+  as `ingest_tps`.
+- **Tracked configs**: lookups, two-phase, linked chains, balancing, mixed
+  split, and the spill-active steady state (which INCLUDES posts of
+  spilled pendings so the pre-commit reload path is measured; its ceiling
+  is set by the degraded-transport artifact above — the first cold row
+  shipped to the host LSM degrades every later 1 MiB batch upload).
 
-No device->host transfer happens ANYWHERE until the timed phases are over
-(on this tunneled runtime the first d2h permanently degrades dispatch to
-~12 ms/launch — measured, see ops/hashtable.py). Verification (result-code
-maxes, fault word, conservation sums) runs after the clock stops, reduced
-on device to scalars.
+No device->host transfer happens in the flagship/ingest phases until their
+clocks stop. Verification (result-code maxes, fault word, conservation
+sums) runs after, reduced on device to scalars.
 
 Prints exactly ONE JSON line to stdout:
   {"metric": ..., "value": N, "unit": "transfers/s", "vs_baseline": N, ...}
@@ -331,14 +340,35 @@ def _bench_spill_config(stage, out, rng) -> None:
             next_id += k
         n_sp = 0
         nbatches = int(os.environ.get("BENCH_SPILL_BATCHES", 24))
+        n_pend = max(2, nbatches // 6)  # oldest batches: spilled first
+        n_post = n_pend // 2  # posts of (by then) SPILLED pendings
         warm = build_transfers(rng, 5_000_000, BATCH)
         ts2 += BATCH
         ledger.drain(ledger.execute_async(
             Operation.create_transfers, ts2, warm
         ))
+        pend_bodies = []
         t0 = time.perf_counter()
         for g in range(nbatches):
-            b = build_transfers(rng, 6_000_000 + g * BATCH, BATCH)
+            if g < n_pend:
+                # two-phase pendings on a reserved account range; their
+                # rows age out to the LSM store before the posts arrive
+                b = build_transfers(rng, 6_000_000 + g * BATCH, BATCH)
+                b["flags"] = 2  # pending
+                pend_bodies.append(b.copy())
+            elif g >= nbatches - n_post and pend_bodies:
+                # posts referencing SPILLED pendings: the pre-commit
+                # reload path (the prefetch contract) under measurement
+                p = pend_bodies.pop(0)
+                b = np.zeros(BATCH, dtype=p.dtype)
+                b["id_lo"] = np.arange(
+                    8_000_000 + g * BATCH, 8_000_000 + (g + 1) * BATCH,
+                    dtype=np.uint64,
+                )
+                b["pending_id_lo"] = p["id_lo"]
+                b["flags"] = 4  # post_pending_transfer
+            else:
+                b = build_transfers(rng, 6_000_000 + g * BATCH, BATCH)
             ts2 += BATCH
             ledger.drain(ledger.execute_async(
                 Operation.create_transfers, ts2, b
@@ -351,28 +381,67 @@ def _bench_spill_config(stage, out, rng) -> None:
         out["spill_active_tps"] = round(n_sp / (time.perf_counter() - t0), 1)
         out["spill_stats"] = dict(ledger.spill.stats)
         assert ledger.spill.stats["cycles"] >= 2, "spill never engaged"
+        assert ledger.spill.stats["reloaded"] > 0, (
+            "spill bench never exercised the reload path"
+        )
 
 
 def bench_e2e(stage) -> dict:
-    """The durable, through-consensus number: format a data file, start a
+    """The durable, through-consensus numbers: format a data file, start a
     REAL replica process (WAL on), drive create_transfers through TCP
     session clients at batch=8190 and verify conservation over the wire —
     the reference's actual measurement protocol (reference:
-    scripts/benchmark.sh:34-78, src/benchmark.zig:23-73). MUST run before
-    this process touches JAX: the server subprocess owns the TPU chip."""
+    scripts/benchmark.sh:34-78, src/benchmark.zig:23-73). Three runs:
+
+    - native backend, simple transfers (the headline durable_tps — the
+      C++ host engine is the durable commit path, native/ledger.cc);
+    - native backend, two-phase-heavy (pend->post pairs; the workload the
+      per-op fallback used to hide);
+    - device backend, short run (the TPU-commit through-stack number —
+      honest about this environment's post-d2h degraded transport, see
+      models/native_ledger.py).
+
+    MUST run before this process touches JAX: the device-backend server
+    subprocess owns the TPU chip."""
     from tigerbeetle_tpu.benchmark import run_e2e
 
-    n = int(os.environ.get("BENCH_E2E_TRANSFERS", 1_000_000))
-    clients = int(os.environ.get("BENCH_E2E_CLIENTS", 16))
-    with stage("e2e_durable"):
-        try:
-            return run_e2e(
+    log = lambda *a: print("[e2e]", *a, file=sys.stderr)  # noqa: E731
+    n = int(os.environ.get("BENCH_E2E_TRANSFERS", 2_000_000))
+    clients = int(os.environ.get("BENCH_E2E_CLIENTS", 8))
+    try:
+        with stage("e2e_durable"):
+            out = run_e2e(
                 n_accounts=N_ACCOUNTS, n_transfers=n, clients=clients,
-                log=lambda *a: print("[e2e]", *a, file=sys.stderr),
+                log=log,
             )
-        except Exception as e:  # never sink the kernel benchmark
-            print(f"[e2e] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
-            return {"durable_tps": 0.0, "error": f"{type(e).__name__}: {e}"}
+    except Exception as e:  # never sink the kernel benchmark
+        print(f"[e2e] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        return {"durable_tps": 0.0, "error": f"{type(e).__name__}: {e}"}
+    try:
+        with stage("e2e_two_phase"):
+            tp = run_e2e(
+                n_accounts=N_ACCOUNTS,
+                n_transfers=int(os.environ.get("BENCH_E2E_TP", 1_000_000)),
+                clients=clients, workload="two_phase", log=log,
+            )
+        out["two_phase"] = tp
+        out["durable_two_phase_tps"] = tp["durable_tps"]
+    except Exception as e:
+        out["two_phase"] = {"error": f"{type(e).__name__}: {e}"}
+        print(f"[e2e two-phase] FAILED: {e}", file=sys.stderr)
+    try:
+        with stage("e2e_device"):
+            dv = run_e2e(
+                n_accounts=N_ACCOUNTS,
+                n_transfers=int(os.environ.get("BENCH_E2E_DEV", 200_000)),
+                clients=16, backend="device", log=log,
+            )
+        out["device_backend"] = dv
+        out["durable_device_tps"] = dv["durable_tps"]
+    except Exception as e:
+        out["device_backend"] = {"error": f"{type(e).__name__}: {e}"}
+        print(f"[e2e device] FAILED: {e}", file=sys.stderr)
+    return out
 
 
 def main() -> None:
@@ -464,21 +533,38 @@ def main() -> None:
             next_id += BATCH
         done += n_latency
 
-    # throughput: K-fused dispatches, block once at the end
+    # throughput: K-fused dispatches in 5 equal segments, each blocked at
+    # its end — median-of-5 with per-run values (a single sample hid a 3x
+    # spread across rounds; the spread itself is now measured)
     n_groups = max(0, (n_flag_batches - done) // K_FUSE)
-    t0 = time.perf_counter()
-    for g in range(n_groups):
-        ts += K_FUSE * BATCH
-        state, code_max = stepper(
-            state, code_max, jax.random.fold_in(key, 10_000 + g),
-            jnp.uint64(next_id), jnp.uint64(ts),
-        )
-        next_id += K_FUSE * BATCH
-    jax.block_until_ready(code_max)
-    dt = time.perf_counter() - t0
-    stages["flagship"] = dt
+    seg_runs: list[float] = []
+    n_segs = 5 if n_groups >= 5 else 1
+    seg_size = n_groups // n_segs
+    g = 0
+    t_all = time.perf_counter()
+    for seg in range(n_segs):
+        take = seg_size if seg < n_segs - 1 else n_groups - seg_size * (n_segs - 1)
+        t0 = time.perf_counter()
+        for _ in range(take):
+            ts += K_FUSE * BATCH
+            state, code_max = stepper(
+                state, code_max, jax.random.fold_in(key, 10_000 + g),
+                jnp.uint64(next_id), jnp.uint64(ts),
+            )
+            next_id += K_FUSE * BATCH
+            g += 1
+        jax.block_until_ready(code_max)
+        dt = time.perf_counter() - t0
+        if take:
+            seg_runs.append(take * K_FUSE * BATCH / dt)
+    stages["flagship"] = time.perf_counter() - t_all
     n_timed = n_groups * K_FUSE * BATCH
-    flagship_tps = n_timed / dt if n_timed else 0.0
+    flagship_tps = float(np.median(seg_runs)) if seg_runs else 0.0
+    flagship_spread = (
+        round((max(seg_runs) - min(seg_runs)) / flagship_tps, 4)
+        if seg_runs and flagship_tps
+        else None
+    )
     ledger.state = state
     ledger._xfer_used += done * BATCH + n_timed
 
@@ -571,18 +657,27 @@ def main() -> None:
             {
                 "metric": "create_transfers throughput, batch=8190, 10k accounts, "
                 f"{n_timed} transfers (device-generated ingest; "
-                "full commit kernel, verified conservation + result codes)",
+                "full commit kernel, verified conservation + result codes; "
+                "median of 5 timed segments)",
                 "value": round(flagship_tps, 1),
                 "unit": "transfers/s",
                 "vs_baseline": round(flagship_tps / BASELINE_TPS, 4),
+                "flagship_runs": [round(x, 1) for x in seg_runs],
+                "flagship_spread": flagship_spread,
                 "latency_ms_p00_p25_p50_p75_p100": [round(x, 2) for x in lat],
                 "ingest_tps": round(ingest_tps, 1),
                 "ingest_note": f"host-upload path over the ~143 MiB/s tunnel, "
                 f"{n_ingest} transfers at 128 B each",
                 "durable_tps": e2e.get("durable_tps", 0.0),
-                "durable_note": "through the FULL stack: real replica process, "
-                "WAL + consensus + TCP clients at batch=8190, conservation "
-                "verified over the wire (the BASELINE measurement protocol)",
+                "durable_note": "through the FULL stack: real replica process "
+                "(native C++ commit engine), WAL + consensus + TCP clients at "
+                "batch=8190, conservation verified over the wire (the "
+                "BASELINE measurement protocol)",
+                "durable_two_phase_tps": e2e.get("durable_two_phase_tps", 0.0),
+                "durable_device_tps": e2e.get("durable_device_tps", 0.0),
+                "group_commit_hit_rate": e2e.get(
+                    "device_backend", {}
+                ).get("group_commit_hit_rate", 0.0),
                 "durable": e2e,
                 "configs": configs,
             }

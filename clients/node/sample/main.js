@@ -1,0 +1,58 @@
+// Sample: two-phase transfer lifecycle through the Node client
+// (mirrors clients/go/sample/main.go and the reference's node walkthrough).
+//
+// Run against a live cluster:
+//   node clients/node/sample/main.js 127.0.0.1:3001
+
+"use strict";
+
+const { Client } = require("../tb_client");
+
+function assertEqual(got, want, what) {
+  if (got !== want) throw new Error(`${what}: got ${got}, want ${want}`);
+}
+
+const addresses = process.argv[2] || "127.0.0.1:3001";
+const c = new Client(addresses, 0);
+try {
+  let errs = c.createAccounts([
+    { id: 1n, ledger: 1, code: 1 },
+    { id: 2n, ledger: 1, code: 1 },
+  ]);
+  assertEqual(errs.length, 0, "createAccounts errors");
+
+  // pending, then partial post (two-phase; reference:
+  // src/state_machine.zig:907-1014)
+  errs = c.createTransfers([
+    {
+      id: 100n, debit_account_id: 1n, credit_account_id: 2n,
+      amount: 500n, ledger: 1, code: 1, flags: 1 << 1 /* pending */,
+      timeout: 3600,
+    },
+  ]);
+  assertEqual(errs.length, 0, "pending transfer errors");
+  errs = c.createTransfers([
+    {
+      id: 101n, pending_id: 100n, amount: 300n, ledger: 1, code: 1,
+      flags: 1 << 2 /* post_pending_transfer */,
+    },
+  ]);
+  assertEqual(errs.length, 0, "post errors");
+
+  const accounts = c.lookupAccounts([1n, 2n]);
+  assertEqual(accounts.length, 2, "accounts found");
+  assertEqual(accounts[0].debits_posted, 300n, "debits_posted");
+  assertEqual(accounts[1].credits_posted, 300n, "credits_posted");
+  assertEqual(accounts[0].debits_pending, 0n, "pending released");
+
+  const transfers = c.lookupTransfers([100n, 101n]);
+  assertEqual(transfers.length, 2, "transfers found");
+  assertEqual(transfers[1].amount, 300n, "posted amount");
+
+  // empty batch is a no-op, not an error
+  assertEqual(c.createAccounts([]).length, 0, "empty batch");
+
+  console.log("node sample: OK");
+} finally {
+  c.close();
+}

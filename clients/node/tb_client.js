@@ -1,0 +1,259 @@
+// Node client for the tigerbeetle_tpu cluster: an FFI wrapper over the
+// tb_client C ABI (native/tb_client.{h,cc}) — the same layering as the
+// reference's Node client (reference: src/clients/node wraps
+// src/clients/c/tb_client.zig). Session registration, retries, checksums,
+// and wire framing live in the shared native library; this file converts
+// between JS objects and the 128-byte little-endian wire structs
+// (field layout: clients/node/types.ts, generated from the one schema).
+//
+// Runtime: requires the `koffi` (or API-compatible `ffi-napi`) FFI package
+// — this repo's CI image has no Node runtime, so the client is exercised
+// where one exists; the exact C ABI call sequence it makes is replayed by
+// tests/test_c_abi_sequence.py via ctypes everywhere (same coverage
+// contract as the Go client, clients/go/tb_client.go).
+//
+// Usage:
+//   const { Client } = require("./tb_client");
+//   const c = new Client("127.0.0.1:3001", 0);
+//   const errs = c.createAccounts([{ id: 1n, ledger: 1, code: 1 }]);
+
+"use strict";
+
+const crypto = require("crypto");
+
+const OP_CREATE_ACCOUNTS = 128;
+const OP_CREATE_TRANSFERS = 129;
+const OP_LOOKUP_ACCOUNTS = 130;
+const OP_LOOKUP_TRANSFERS = 131;
+
+const EVENT_SIZE = 128;
+const RESULT_SIZE = 8;
+const ID_SIZE = 16;
+
+function loadNative(libPath) {
+  // koffi first (pure-prebuilt, no node-gyp), ffi-napi as fallback
+  let koffi;
+  try {
+    koffi = require("koffi");
+  } catch (_e) {
+    koffi = null;
+  }
+  const path = libPath || `${__dirname}/../../native/libtb_native.so`;
+  if (koffi) {
+    const lib = koffi.load(path);
+    return {
+      init: lib.func(
+        "int tb_client_init(_Out_ void **out, const char *addresses, int port, uint32_t cluster, const uint8_t *client_id)"
+      ),
+      request: lib.func(
+        "int tb_client_request(void *client, uint8_t operation, const void *body, uint64_t body_len, _Out_ uint8_t *reply, uint64_t reply_cap, _Out_ uint64_t *reply_len)"
+      ),
+      deinit: lib.func("void tb_client_deinit(void *client)"),
+      kind: "koffi",
+    };
+  }
+  const ffi = require("ffi-napi");
+  const ref = require("ref-napi");
+  const voidPP = ref.refType(ref.refType(ref.types.void));
+  const lib = ffi.Library(path, {
+    tb_client_init: ["int", [voidPP, "string", "int", "uint32", "pointer"]],
+    tb_client_request: [
+      "int",
+      ["pointer", "uint8", "pointer", "uint64", "pointer", "uint64", "pointer"],
+    ],
+    tb_client_deinit: ["void", ["pointer"]],
+  });
+  return { lib, ref, kind: "ffi-napi" };
+}
+
+// -- wire struct packing (layouts: tigerbeetle_tpu/types.py dtypes) --
+
+function writeU128(buf, off, v) {
+  buf.writeBigUInt64LE(BigInt(v) & 0xffffffffffffffffn, off);
+  buf.writeBigUInt64LE(BigInt(v) >> 64n, off + 8);
+}
+
+function readU128(buf, off) {
+  return buf.readBigUInt64LE(off) | (buf.readBigUInt64LE(off + 8) << 64n);
+}
+
+function packAccount(a) {
+  const b = Buffer.alloc(EVENT_SIZE);
+  writeU128(b, 0, a.id ?? 0n);
+  writeU128(b, 16, a.debits_pending ?? 0n);
+  writeU128(b, 32, a.debits_posted ?? 0n);
+  writeU128(b, 48, a.credits_pending ?? 0n);
+  writeU128(b, 64, a.credits_posted ?? 0n);
+  writeU128(b, 80, a.user_data_128 ?? 0n);
+  b.writeBigUInt64LE(BigInt(a.user_data_64 ?? 0), 96);
+  b.writeUInt32LE(a.user_data_32 ?? 0, 104);
+  b.writeUInt32LE(a.reserved ?? 0, 108);
+  b.writeUInt32LE(a.ledger ?? 0, 112);
+  b.writeUInt16LE(a.code ?? 0, 116);
+  b.writeUInt16LE(a.flags ?? 0, 118);
+  b.writeBigUInt64LE(BigInt(a.timestamp ?? 0), 120);
+  return b;
+}
+
+function unpackAccount(b, off) {
+  return {
+    id: readU128(b, off),
+    debits_pending: readU128(b, off + 16),
+    debits_posted: readU128(b, off + 32),
+    credits_pending: readU128(b, off + 48),
+    credits_posted: readU128(b, off + 64),
+    user_data_128: readU128(b, off + 80),
+    user_data_64: b.readBigUInt64LE(off + 96),
+    user_data_32: b.readUInt32LE(off + 104),
+    reserved: b.readUInt32LE(off + 108),
+    ledger: b.readUInt32LE(off + 112),
+    code: b.readUInt16LE(off + 116),
+    flags: b.readUInt16LE(off + 118),
+    timestamp: b.readBigUInt64LE(off + 120),
+  };
+}
+
+function packTransfer(t) {
+  const b = Buffer.alloc(EVENT_SIZE);
+  writeU128(b, 0, t.id ?? 0n);
+  writeU128(b, 16, t.debit_account_id ?? 0n);
+  writeU128(b, 32, t.credit_account_id ?? 0n);
+  writeU128(b, 48, t.amount ?? 0n);
+  writeU128(b, 64, t.pending_id ?? 0n);
+  writeU128(b, 80, t.user_data_128 ?? 0n);
+  b.writeBigUInt64LE(BigInt(t.user_data_64 ?? 0), 96);
+  b.writeUInt32LE(t.user_data_32 ?? 0, 104);
+  b.writeUInt32LE(t.timeout ?? 0, 108);
+  b.writeUInt32LE(t.ledger ?? 0, 112);
+  b.writeUInt16LE(t.code ?? 0, 116);
+  b.writeUInt16LE(t.flags ?? 0, 118);
+  b.writeBigUInt64LE(BigInt(t.timestamp ?? 0), 120);
+  return b;
+}
+
+function unpackTransfer(b, off) {
+  return {
+    id: readU128(b, off),
+    debit_account_id: readU128(b, off + 16),
+    credit_account_id: readU128(b, off + 32),
+    amount: readU128(b, off + 48),
+    pending_id: readU128(b, off + 64),
+    user_data_128: readU128(b, off + 80),
+    user_data_64: b.readBigUInt64LE(off + 96),
+    user_data_32: b.readUInt32LE(off + 104),
+    timeout: b.readUInt32LE(off + 108),
+    ledger: b.readUInt32LE(off + 112),
+    code: b.readUInt16LE(off + 116),
+    flags: b.readUInt16LE(off + 118),
+    timestamp: b.readBigUInt64LE(off + 120),
+  };
+}
+
+function unpackResults(reply) {
+  const out = [];
+  for (let off = 0; off + RESULT_SIZE <= reply.length; off += RESULT_SIZE) {
+    out.push({
+      index: reply.readUInt32LE(off),
+      result: reply.readUInt32LE(off + 4),
+    });
+  }
+  return out;
+}
+
+class Client {
+  // addresses: "host:port[,host:port...]"; cluster id must match format.
+  constructor(addresses, cluster, libPath) {
+    this._native = loadNative(libPath);
+    const id = crypto.randomBytes(16);
+    id[0] |= 1; // nonzero
+    if (this._native.kind === "koffi") {
+      const out = [null];
+      const rc = this._native.init(out, addresses, 0, cluster >>> 0, id);
+      if (rc !== 0) throw new Error(`tb_client_init: errno ${-rc}`);
+      this._handle = out[0];
+    } else {
+      const { lib, ref } = this._native;
+      const outPtr = ref.alloc("pointer");
+      const rc = lib.tb_client_init(outPtr, addresses, 0, cluster >>> 0, id);
+      if (rc !== 0) throw new Error(`tb_client_init: errno ${-rc}`);
+      this._handle = outPtr.deref();
+    }
+  }
+
+  close() {
+    if (!this._handle) return;
+    if (this._native.kind === "koffi") this._native.deinit(this._handle);
+    else this._native.lib.tb_client_deinit(this._handle);
+    this._handle = null;
+  }
+
+  _request(op, body, replyCap) {
+    if (replyCap === 0) return Buffer.alloc(0); // empty batch: no-op
+    const reply = Buffer.alloc(replyCap);
+    if (this._native.kind === "koffi") {
+      const lenOut = [0n];
+      const rc = this._native.request(
+        this._handle, op, body, BigInt(body.length), reply,
+        BigInt(replyCap), lenOut
+      );
+      if (rc !== 0) throw new Error(`tb_client_request: errno ${-rc}`);
+      return reply.subarray(0, Number(lenOut[0]));
+    }
+    const { lib, ref } = this._native;
+    const lenPtr = ref.alloc("uint64");
+    const rc = lib.tb_client_request(
+      this._handle, op, body, body.length, reply, replyCap, lenPtr
+    );
+    if (rc !== 0) throw new Error(`tb_client_request: errno ${-rc}`);
+    return reply.subarray(0, Number(lenPtr.deref()));
+  }
+
+  // Sparse non-ok {index, result} pairs; empty array = all applied.
+  createAccounts(accounts) {
+    const body = Buffer.concat(accounts.map(packAccount));
+    return unpackResults(
+      this._request(OP_CREATE_ACCOUNTS, body, accounts.length * RESULT_SIZE)
+    );
+  }
+
+  createTransfers(transfers) {
+    const body = Buffer.concat(transfers.map(packTransfer));
+    return unpackResults(
+      this._request(OP_CREATE_TRANSFERS, body, transfers.length * RESULT_SIZE)
+    );
+  }
+
+  // Found rows in request order (missing ids skipped).
+  lookupAccounts(ids) {
+    const body = Buffer.alloc(ids.length * ID_SIZE);
+    ids.forEach((x, i) => writeU128(body, i * ID_SIZE, x));
+    const reply = this._request(
+      OP_LOOKUP_ACCOUNTS, body, ids.length * EVENT_SIZE
+    );
+    const out = [];
+    for (let off = 0; off + EVENT_SIZE <= reply.length; off += EVENT_SIZE)
+      out.push(unpackAccount(reply, off));
+    return out;
+  }
+
+  lookupTransfers(ids) {
+    const body = Buffer.alloc(ids.length * ID_SIZE);
+    ids.forEach((x, i) => writeU128(body, i * ID_SIZE, x));
+    const reply = this._request(
+      OP_LOOKUP_TRANSFERS, body, ids.length * EVENT_SIZE
+    );
+    const out = [];
+    for (let off = 0; off + EVENT_SIZE <= reply.length; off += EVENT_SIZE)
+      out.push(unpackTransfer(reply, off));
+    return out;
+  }
+}
+
+module.exports = {
+  Client,
+  packAccount,
+  packTransfer,
+  unpackAccount,
+  unpackTransfer,
+  unpackResults,
+};

@@ -1,0 +1,69 @@
+"""hash_log record/check (reference: src/testing/hash_log.zig): identical
+runs replay hash-for-hash; an injected nondeterminism is caught AT the
+first divergent op, on the prepare stream (log divergence) or the reply
+stream (execution divergence with an identical log)."""
+
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.testing.hash_log import HashLog, HashLogDivergence
+from tigerbeetle_tpu.testing.workload import WorkloadGenerator
+
+
+def _run(log: HashLog, tamper_batch: int | None = None) -> None:
+    cluster = Cluster(replica_count=1)
+    log.attach(cluster.replicas[0])
+    client = cluster.add_client()
+    gen = WorkloadGenerator(33)
+    for b in range(5):
+        if b % 2 == 0:
+            op, events = gen.gen_accounts_batch(10)
+            body = types.accounts_to_np(events).tobytes()
+        else:
+            op, events = gen.gen_transfers_batch(10)
+            arr = types.transfers_to_np(events)
+            if tamper_batch == b:
+                arr["amount_lo"][3] += 1  # the injected nondeterminism
+            body = arr.tobytes()
+        cluster.execute(client, op, body)
+
+
+def test_identical_runs_check_clean(tmp_path):
+    path = str(tmp_path / "run.hashlog")
+    rec = HashLog("record")
+    _run(rec)
+    rec.save(path)
+    chk = HashLog("check", path)
+    _run(chk)  # raises on any divergence
+    assert chk.digest() == rec.digest()
+
+
+def test_injected_divergence_caught_at_first_op(tmp_path):
+    path = str(tmp_path / "run.hashlog")
+    rec = HashLog("record")
+    _run(rec)
+    rec.save(path)
+    chk = HashLog("check", path)
+    with pytest.raises(HashLogDivergence) as e:
+        _run(chk, tamper_batch=3)
+    # batch 3 is the 4th request; op 1 is the session register -> op 5
+    assert e.value.op == 5
+    assert e.value.kind == "prepare"  # body changed -> log diverges
+
+
+def test_reply_stream_catches_execution_divergence(tmp_path):
+    """Same LOG, different results: simulate a kernel nondeterminism by
+    checking a recording whose reply hash was corrupted — the prepare
+    stream stays clean, the reply stream trips."""
+    path = str(tmp_path / "run.hashlog")
+    rec = HashLog("record")
+    _run(rec)
+    # corrupt op 5's recorded REPLY hash only
+    rec.entries[5][1] ^= 1
+    rec.save(path)
+    chk = HashLog("check", path)
+    with pytest.raises(HashLogDivergence) as e:
+        _run(chk)
+    assert e.value.op == 5
+    assert e.value.kind == "reply"

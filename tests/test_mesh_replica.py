@@ -1,0 +1,139 @@
+"""The sharded ledger BEHIND the StateMachine seam (VERDICT r3 item 3):
+a replica whose commit backend is the multi-chip ShardedLedger over the
+virtual 8-device CPU mesh — journal + consensus + sharded device commit +
+reply, not a bare kernel demo (SURVEY.md §5.8: sharding is an internal
+implementation detail behind the StateMachine interface).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import ConfigProcess
+from tigerbeetle_tpu.state_machine import decode_accounts, encode_ids
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.types import Operation
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    return Mesh(np.array(devices[:8]), ("shard",))
+
+
+def _factory(mesh8):
+    from tigerbeetle_tpu.parallel.mesh import ShardedLedger
+
+    process = ConfigProcess(account_slots_log2=8, transfer_slots_log2=10)
+    return lambda: ShardedLedger(mesh8, process)
+
+
+def test_replica_commits_through_sharded_backend(mesh8):
+    factory = _factory(mesh8)
+    cluster = Cluster(replica_count=1, backend_factory=factory)
+    client = cluster.add_client()
+
+    accounts = [types.Account(id=i, ledger=1, code=1) for i in range(1, 25)]
+    _h, reply = cluster.execute(
+        client, Operation.create_accounts,
+        types.accounts_to_np(accounts).tobytes(),
+    )
+    assert reply == b""
+
+    xfers = [
+        types.Transfer(id=500 + i, debit_account_id=1 + i % 24,
+                       credit_account_id=1 + (i + 11) % 24, amount=2,
+                       ledger=1, code=1)
+        for i in range(48)
+    ]
+    _h, reply = cluster.execute(
+        client, Operation.create_transfers,
+        types.transfers_to_np(xfers).tobytes(),
+    )
+    assert reply == b""
+
+    # lookups through consensus hit the sharded tables (psum-fused finds)
+    _h, body = cluster.execute(
+        client, Operation.lookup_accounts, encode_ids(list(range(1, 25)))
+    )
+    rows = decode_accounts(body)
+    assert len(rows) == 24
+    assert rows["debits_posted_lo"].sum() == 96  # 48 transfers x amount 2
+    assert rows["credits_posted_lo"].sum() == 96
+
+    # duplicate submission answers exists codes from the sharded state
+    _h, reply = cluster.execute(
+        client, Operation.create_transfers,
+        types.transfers_to_np(xfers[:4]).tobytes(),
+    )
+    from tigerbeetle_tpu.state_machine import decode_results
+
+    got = decode_results(reply, Operation.create_transfers)
+    assert got == [(i, int(types.CreateTransferResult.exists))
+                   for i in range(4)]
+
+
+def test_sharded_checkpoint_restart_and_resume(mesh8):
+    """Checkpoint (sharded snapshot blob) + crash-restart + continue:
+    the restored mesh state serves lookups identically and accepts new
+    commits (the WAL replay path runs through the sharded backend too)."""
+    factory = _factory(mesh8)
+    cluster = Cluster(replica_count=1, backend_factory=factory)
+    client = cluster.add_client()
+    accounts = [types.Account(id=i, ledger=1, code=1) for i in range(1, 9)]
+    cluster.execute(
+        client, Operation.create_accounts,
+        types.accounts_to_np(accounts).tobytes(),
+    )
+    xfers = [
+        types.Transfer(id=900 + i, debit_account_id=1 + i % 8,
+                       credit_account_id=1 + (i + 3) % 8, amount=1,
+                       ledger=1, code=1)
+        for i in range(16)
+    ]
+    cluster.execute(
+        client, Operation.create_transfers,
+        types.transfers_to_np(xfers).tobytes(),
+    )
+    replica = cluster.replicas[0]
+    replica.checkpoint()
+
+    # post-checkpoint ops live only in the WAL: replay goes through the
+    # sharded backend at open()
+    xfers2 = [
+        types.Transfer(id=950 + i, debit_account_id=1 + i % 8,
+                       credit_account_id=1 + (i + 5) % 8, amount=1,
+                       ledger=1, code=1)
+        for i in range(8)
+    ]
+    cluster.execute(
+        client, Operation.create_transfers,
+        types.transfers_to_np(xfers2).tobytes(),
+    )
+    before = replica.sm.commit(
+        Operation.lookup_accounts, 0, encode_ids(list(range(1, 9)))
+    )
+
+    cluster.restart_replica(0, backend_factory=factory)
+    client2 = cluster.add_client()
+    _h, after = cluster.execute(
+        client2, Operation.lookup_accounts, encode_ids(list(range(1, 9)))
+    )
+    assert after == before
+    rows = decode_accounts(after)
+    assert rows["debits_posted_lo"].sum() == 24  # 16 + 8 transfers
+
+    # and the restarted sharded replica still commits
+    _h, reply = cluster.execute(
+        client2, Operation.create_transfers,
+        types.transfers_to_np([
+            types.Transfer(id=999, debit_account_id=1, credit_account_id=2,
+                           amount=5, ledger=1, code=1)
+        ]).tobytes(),
+    )
+    assert reply == b""

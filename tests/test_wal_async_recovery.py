@@ -13,7 +13,6 @@ restart (reference: src/vsr/journal.zig:374-535 classifies such slots in
 its recovery decision matrix).
 """
 
-import numpy as np
 
 from tigerbeetle_tpu import types
 from tigerbeetle_tpu.testing.cluster import Cluster

@@ -53,7 +53,8 @@ def _accounts_body(start_id: int, count: int) -> bytes:
     return arr.tobytes()
 
 
-def _transfers_body(rng, start_id: int, count: int, n_accounts: int) -> bytes:
+def _transfers_body(rng, start_id: int, count: int, n_accounts: int,
+                    flags: int = 0) -> bytes:
     arr = np.zeros(count, dtype=TRANSFER_DTYPE)
     # id_order=reversed (reference: src/benchmark.zig:66-73 default)
     arr["id_lo"] = np.arange(
@@ -66,6 +67,19 @@ def _transfers_body(rng, start_id: int, count: int, n_accounts: int) -> bytes:
     arr["amount_lo"] = 1
     arr["ledger"] = 1
     arr["code"] = 1
+    arr["flags"] = flags
+    return arr.tobytes()
+
+
+def _post_body(pend_body: bytes, start_id: int) -> bytes:
+    """Full-amount posts of every pending transfer in `pend_body`
+    (two-phase second leg; reference: src/state_machine.zig:907-1014)."""
+    pend = np.frombuffer(pend_body, dtype=TRANSFER_DTYPE)
+    arr = np.zeros(len(pend), dtype=TRANSFER_DTYPE)
+    arr["id_lo"] = np.arange(start_id, start_id + len(pend), dtype=np.uint64)
+    arr["pending_id_lo"] = pend["id_lo"]
+    arr["pending_id_hi"] = pend["id_hi"]
+    arr["flags"] = 4  # post_pending_transfer
     return arr.tobytes()
 
 
@@ -115,6 +129,7 @@ def run_e2e(
     tmpdir: str | None = None,
     server_args: tuple[str, ...] = (),
     backend: str = "native",
+    workload: str = "simple",
     log=None,
 ) -> dict:
     """Format, start a real replica, drive the protocol, return metrics.
@@ -187,7 +202,7 @@ def run_e2e(
         drain_thread.start()
         result = _drive(
             proc, port, n_accounts, n_transfers, batch, clients,
-            warmup_batches, log,
+            warmup_batches, log, workload=workload,
         )
         # SIGTERM makes the server emit its [stats] line (group-commit hit
         # rate etc.); after exit the pipe hits EOF, so joining the drain
@@ -220,7 +235,7 @@ def run_e2e(
 
 
 def _drive(proc, port, n_accounts, n_transfers, batch, clients,
-           warmup_batches, log) -> dict:
+           warmup_batches, log, workload: str = "simple") -> dict:
     from tigerbeetle_tpu.state_machine import decode_results
 
     rng = np.random.default_rng(42)
@@ -245,10 +260,8 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
     # -- warmup rounds: singles compile the per-batch kernel; k
     # simultaneous batches compile each fused group kernel (k=8/4/2) —
     # lazily compiling those mid-run would stall the timed phase for
-    # tens of seconds each --
-    # One round per fused-kernel capacity the steady state will hit
-    # (DeviceLedger.GROUP_KS): a run of k pads to the next capacity, so
-    # min(capacity, clients) warms each kernel even when clients < 16.
+    # tens of seconds each (device backend; the native engine just warms
+    # its caches) --
     from tigerbeetle_tpu.models.ledger import DeviceLedger
 
     group_rounds = sorted(
@@ -259,47 +272,69 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
     rounds = [1] * warmup_batches + group_rounds
     total_warm = sum(rounds)
 
-    # -- build all transfer bodies up front (workload gen off the clock) --
-    bodies = []
-    next_id = 1_000_000
-    remaining = n_transfers + total_warm * batch
-    while remaining > 0:
-        n = min(batch, remaining)
-        bodies.append(_transfers_body(rng, next_id, n, n_accounts))
-        next_id += n
-        remaining -= n
+    # -- build all bodies up front (workload gen off the clock), split
+    # into PER-SESSION queues. two_phase: each session alternates a
+    # pending batch with the full-amount posts of ITS OWN previous batch
+    # (the session's one-in-flight protocol orders post after pend) --
+    id_stride = (n_transfers // clients + 3 * batch) * 2
+    per_session: list[list[bytes]] = [[] for _ in sessions]
+    n_total_batches = (n_transfers + batch - 1) // batch + total_warm
+    posted_batches = 0  # batches that land posted amounts (conservation)
+    for i, _s in enumerate(sessions):
+        nid = 1_000_000 + i * id_stride
+        share = n_total_batches // clients + (
+            1 if i < n_total_batches % clients else 0
+        )
+        q = per_session[i]
+        if workload == "two_phase":
+            while len(q) < share:
+                pend = _transfers_body(rng, nid, batch, n_accounts, flags=2)
+                nid += batch
+                q.append(pend)
+                if len(q) < share:
+                    q.append(_post_body(pend, nid))
+                    nid += batch
+                    posted_batches += 1
+        else:
+            for _ in range(share):
+                q.append(_transfers_body(rng, nid, batch, n_accounts))
+                nid += batch
+                posted_batches += 1
 
-    wi = 0
+    # warmup: pull evenly from the per-session queues (two_phase pairs
+    # stay in order within a session)
+    warm_done = 0
     for k in rounds:
-        grp = bodies[wi : wi + k]
-        wi += k
-        for s, b in zip(sessions, grp):
-            s.client.request(Operation.create_transfers, b)
-        for s, _b in zip(sessions, grp):
+        active = [
+            (s, q) for s, q in zip(sessions, per_session) if q
+        ][: max(k, 1)]
+        for s, q in active:
+            s.client.request(Operation.create_transfers, q.pop(0))
+        for s, _q in active:
             _h, body = s.wait_reply(deadline_s=600.0)  # compiles are slow
             assert body == b"", decode_results(
                 body, Operation.create_transfers
             )[:3]
-    work = bodies[total_warm:]
-    log(f"warmup done ({total_warm} batches, rounds {rounds}); "
-        f"timing {len(work)} batches")
+            warm_done += 1
+    n_work = sum(len(q) for q in per_session)
+    log(f"warmup done ({warm_done} batches, rounds {rounds}); "
+        f"timing {n_work} batches")
 
     # -- timed phase: each session keeps one batch in flight --
     lat_ms: list[float] = []
     failures = 0
-    queue = list(reversed(work))  # pop() from the front of the work list
     inflight: dict[int, float] = {}
     t_start = time.monotonic()
-    for s in sessions:
-        if queue:
-            s.client.request(Operation.create_transfers, queue.pop())
+    for s, q in zip(sessions, per_session):
+        if q:
+            s.client.request(Operation.create_transfers, q.pop(0))
             inflight[s.client.client_id] = time.monotonic()
     deadline = t_start + max(600.0, n_transfers / 1000)
     done_batches = 0
     resent: dict[int, float] = {}
     while inflight:
         progressed = False
-        for s in sessions:
+        for s, q in zip(sessions, per_session):
             cid = s.client.client_id
             if cid not in inflight:
                 continue
@@ -316,24 +351,27 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
                 continue
             _h, body = s.client.take_reply()
             lat_ms.append(
-                (time.monotonic() - inflight.pop(s.client.client_id)) * 1e3
+                (time.monotonic() - inflight.pop(cid)) * 1e3
             )
             failures += len(decode_results(body, Operation.create_transfers))
             done_batches += 1
             progressed = True
-            if queue:
-                s.client.request(Operation.create_transfers, queue.pop())
-                inflight[s.client.client_id] = time.monotonic()
+            if q:
+                s.client.request(Operation.create_transfers, q.pop(0))
+                inflight[cid] = time.monotonic()
         if not progressed:
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"benchmark stalled at batch {done_batches}/{len(work)}"
+                    f"benchmark stalled at batch {done_batches}/{n_work}"
                 )
             time.sleep(0.0001)
     wall = time.monotonic() - t_start
-    n_timed = sum(len(b) // 128 for b in work)
+    n_timed = done_batches * batch
     assert failures == 0, f"{failures} transfers failed"
-    total = n_timed + total_warm * batch  # all committed, amount=1 each
+    # conservation total: every POSTED batch moves amount=1 per event
+    # (simple batches post directly; two_phase pend batches only move
+    # pending amounts, released when their post batch lands)
+    total = posted_batches * batch
     return _verify_and_report(
         sessions[0], n_accounts, total, wall, n_timed, lat_ms, clients, log
     )

@@ -53,8 +53,10 @@ class StartArgs:
     # the durable hot path; this environment's tunneled TPU degrades
     # permanently on any device->host fetch, see models/native_ledger.py),
     # "device" = the JAX DeviceLedger (the TPU compute path; supports
-    # HBM->LSM spill + sharding).
+    # HBM->LSM spill), "sharded" = the multi-chip ShardedLedger over a
+    # jax.sharding.Mesh (parallel/mesh.py; slots flags are PER SHARD).
     backend: str = "native"
+    shards: int = 0  # sharded backend: devices in the mesh (0 = all)
 
 
 @dataclasses.dataclass
@@ -136,8 +138,24 @@ def cmd_start(args) -> int:
         backend_factory = lambda: NativeLedger(  # noqa: E731
             args.account_slots_log2, args.transfer_slots_log2
         )
+    elif args.backend == "sharded":
+        import jax
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        from tigerbeetle_tpu.parallel.mesh import ShardedLedger
+
+        devs = jax.devices()
+        if args.shards:
+            devs = devs[: args.shards]
+        mesh = Mesh(_np.array(devs), ("shard",))
+        backend_factory = lambda: ShardedLedger(  # noqa: E731
+            mesh, process_cfg
+        )
     elif args.backend != "device":
-        flags.fatal(f"unknown --backend {args.backend!r} (native|device)")
+        flags.fatal(
+            f"unknown --backend {args.backend!r} (native|device|sharded)"
+        )
     replica = Replica(
         args.replica, len(addresses), storage, bus, RealTime(),
         cluster_cfg, process_cfg, backend_factory=backend_factory,

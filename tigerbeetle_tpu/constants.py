@@ -89,6 +89,9 @@ class ConfigProcess:
     repair_slots: int = 1024
     journal_iops_read_max: int = 8
     journal_iops_write_max: int = 8
+    # LSM forest mutable-table budget (rows buffered before a flush packs
+    # them into grid blocks; reference: table_memory sizing via config).
+    lsm_memtable_max: int = 2048
 
 
 DEFAULT_CLUSTER = ConfigCluster()

@@ -112,6 +112,46 @@ class Groove:
                 self._index_key(off, w, row, ts_key), b"\x00"
             )
 
+    def insert_bulk(self, rows_u8, timestamps) -> None:
+        """Vectorized bulk insert of n wire rows (np.uint8 [n, 128]) with
+        their timestamps (np.uint64 [n]) — the spill cycle's write path.
+        Key construction is numpy byte-slicing (big-endian composite keys
+        built column-wise); each tree takes ONE put_many. Equivalent to n
+        insert() calls, ~50x cheaper in Python overhead."""
+        import numpy as np
+
+        n = len(rows_u8)
+        if n == 0:
+            return
+        ts_be = timestamps.astype(">u8").view(np.uint8).reshape(n, TS_SIZE)
+        ts_flat = ts_be.tobytes()
+        ts_keys = [
+            ts_flat[i * TS_SIZE : (i + 1) * TS_SIZE] for i in range(n)
+        ]
+        rows_flat = rows_u8.tobytes()
+        self.objects.put_many(
+            ts_keys,
+            [rows_flat[i * OBJECT_SIZE : (i + 1) * OBJECT_SIZE]
+             for i in range(n)],
+        )
+        # id key: the 16 LE bytes at offset 0, reversed -> BE u128
+        id_be = rows_u8[:, ID_SIZE - 1 :: -1]  # [n, 16] reversed
+        id_flat = np.ascontiguousarray(id_be).tobytes()
+        self.ids.put_many(
+            [id_flat[i * ID_SIZE : (i + 1) * ID_SIZE] for i in range(n)],
+            ts_keys,
+        )
+        for name, (off, w) in self.index_spec.items():
+            field_be = rows_u8[:, off + w - 1 : (off - 1 if off else None) : -1]
+            comp = np.concatenate(
+                [np.ascontiguousarray(field_be), ts_be], axis=1
+            )
+            sz = w + TS_SIZE
+            flat = comp.tobytes()
+            self.indexes[name].put_many(
+                [flat[i * sz : (i + 1) * sz] for i in range(n)], b"\x00"
+            )
+
     def upsert(self, id_: int, timestamp: int, row: bytes,
                old_row: bytes | None = None) -> None:
         """Replace the object at `timestamp`. With `old_row`, only CHANGED
@@ -218,19 +258,21 @@ class Forest:
     manifest INCREMENTALLY via the ManifestLog block chain
     (lsm/manifest_log.py; reference: src/lsm/manifest_log.zig)."""
 
-    def __init__(self, grid: Grid):
+    def __init__(self, grid: Grid, memtable_max: int = 2048):
         from tigerbeetle_tpu.lsm.manifest_log import ManifestLog
 
         self.grid = grid
         self.manifest_log = ManifestLog(grid)
-        self.accounts = Groove(grid, index_fields=ACCOUNT_INDEX_FIELDS,
+        self.accounts = Groove(grid, memtable_max=memtable_max,
+                               index_fields=ACCOUNT_INDEX_FIELDS,
                                manifest_log=self.manifest_log,
                                tree_ids=ACCOUNT_TREE_IDS)
-        self.transfers = Groove(grid, index_fields=TRANSFER_INDEX_FIELDS,
+        self.transfers = Groove(grid, memtable_max=memtable_max,
+                                index_fields=TRANSFER_INDEX_FIELDS,
                                 manifest_log=self.manifest_log,
                                 tree_ids=TRANSFER_TREE_IDS)
         # posted: pending timestamp -> fulfillment byte (padded value)
-        self.posted = Tree(grid, TS_SIZE, 1, 2048,
+        self.posted = Tree(grid, TS_SIZE, 1, memtable_max,
                            manifest_log=self.manifest_log,
                            tree_id=POSTED_TREE_ID)
 

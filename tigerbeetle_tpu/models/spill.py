@@ -260,7 +260,9 @@ class SpillManager:
         cold_idx = np.nonzero(cold)[0].astype(np.int32)
         hot_idx = np.nonzero(hot)[0].astype(np.int32)
 
-        # 1. Cold rows -> LSM (host pull; insert into groove + posted tree).
+        # 1. Cold rows -> LSM (host pull; BULK insert into groove + posted
+        # tree — vectorized key construction + one put_many per tree; the
+        # per-row Python loop this replaces dominated the whole cycle).
         g = self.forest.transfers
         for start in range(0, len(cold_idx), CHUNK):
             idx = cold_idx[start : start + CHUNK]
@@ -277,19 +279,22 @@ class SpillManager:
             ids_hi = rows[:, 2].astype(np.uint64) | (
                 rows[:, 3].astype(np.uint64) << np.uint64(32)
             )
-            ts_lo = rows[:, 30].astype(np.uint64) | (
+            ts_np = rows[:, 30].astype(np.uint64) | (
                 rows[:, 31].astype(np.uint64) << np.uint64(32)
             )
-            row_bytes = rows.tobytes()
-            for i in range(len(idx)):
-                id_ = int(ids_lo[i]) | (int(ids_hi[i]) << 64)
-                t = int(ts_lo[i])
-                g.insert(id_, t, row_bytes[i * 128 : (i + 1) * 128])
-                if ful[i]:
-                    self.forest.posted.put(
-                        t.to_bytes(8, "big"), bytes([int(ful[i])])
-                    )
-                self.spilled.add(id_)
+            g.insert_bulk(rows.view(np.uint8).reshape(len(idx), 128), ts_np)
+            ful_nz = np.nonzero(ful)[0]
+            if len(ful_nz):
+                ts_be = ts_np[ful_nz].astype(">u8").view(np.uint8)
+                flat = ts_be.tobytes()
+                self.forest.posted.put_many(
+                    [flat[i * 8 : (i + 1) * 8] for i in range(len(ful_nz))],
+                    [bytes([int(x)]) for x in ful[ful_nz]],
+                )
+            self.spilled.update(
+                (int(lo) | (int(hi) << 64))
+                for lo, hi in zip(ids_lo, ids_hi)
+            )
             self.stats["spilled"] += len(idx)
 
         # 2. Rebuild: fresh table, reinsert the hot tail (device-to-device;

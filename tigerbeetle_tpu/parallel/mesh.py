@@ -985,3 +985,77 @@ class ShardedLedger(HostLedgerBase):
     @property
     def commit_timestamp(self) -> int:
         return int(np.asarray(self.state["commit_ts"]))
+
+    # -- checkpoint / state sync (the replica's blob snapshot seam) --
+
+    _SNAP_SHARDED = (
+        "acct_rows", "xfer_rows", "fulfill", "acct_claim", "xfer_claim",
+        "bal_acc", "acct_used_slots", "xfer_used_slots",
+    )
+    _SNAP_REPLICATED = ("commit_ts", "acct_count", "xfer_count", "fault")
+
+    def snapshot_bytes(self) -> bytes:
+        """Serialize the full sharded state (one host pull per leaf) plus
+        the host-side admission state — the replica checkpoints this as its
+        snapshot blob, and state sync ships the same bytes. Byte-identical
+        across replicas with identical histories (the determinism
+        contract)."""
+        import json
+
+        self.check_fault()
+        parts = [
+            np.asarray(self.state[k]).tobytes()
+            for k in self._SNAP_SHARDED + self._SNAP_REPLICATED
+        ]
+        h = self.hazards
+        head = json.dumps({
+            "n_shards": self.n_shards,
+            "acct_slots_log2": self.process.account_slots_log2,
+            "xfer_slots_log2": self.process.transfer_slots_log2,
+            "sizes": [len(p) for p in parts],
+            "acct_used": self._acct_used.tolist(),
+            "xfer_used": self._xfer_used.tolist(),
+            "amount_sum": str(h.amount_sum),
+            "limit_account_ids": [str(x) for x in sorted(h.limit_account_ids)],
+        }, sort_keys=True).encode()
+        return len(head).to_bytes(4, "little") + head + b"".join(parts)
+
+    def restore_bytes(self, raw: bytes) -> None:
+        import json
+
+        hn = int.from_bytes(raw[:4], "little")
+        head = json.loads(raw[4 : 4 + hn])
+        if (
+            head["n_shards"] != self.n_shards
+            or head["acct_slots_log2"] != self.process.account_slots_log2
+            or head["xfer_slots_log2"] != self.process.transfer_slots_log2
+        ):
+            raise RuntimeError(
+                "sharded checkpoint geometry mismatch: snapshot is "
+                f"{head['n_shards']} shards @ 2^{head['acct_slots_log2']}/"
+                f"2^{head['xfer_slots_log2']}, this mesh is "
+                f"{self.n_shards} @ 2^{self.process.account_slots_log2}/"
+                f"2^{self.process.transfer_slots_log2}"
+            )
+        fresh = init_sharded_state(self.mesh, self.process)
+        off = 4 + hn
+        names = self._SNAP_SHARDED + self._SNAP_REPLICATED
+        for name, size in zip(names, head["sizes"]):
+            ref = fresh[name]
+            # .dtype/.shape are metadata — never np.asarray(ref) here (a
+            # full d2h gather per leaf, twice, on the degrading transport)
+            host = np.frombuffer(
+                raw[off : off + size], dtype=ref.dtype
+            ).reshape(ref.shape)
+            fresh[name] = jax.device_put(jnp.asarray(host), ref.sharding)
+            off += size
+        self.state = fresh
+        self._acct_used = np.array(head["acct_used"], dtype=np.int64)
+        self._xfer_used = np.array(head["xfer_used"], dtype=np.int64)
+        h = self.hazards
+        h.amount_sum = int(head["amount_sum"])
+        h.limit_account_ids = {int(x) for x in head["limit_account_ids"]}
+        h._limit_lo = np.sort(np.array(
+            [int(x) & ((1 << 64) - 1) for x in head["limit_account_ids"]],
+            dtype=np.uint64,
+        ))

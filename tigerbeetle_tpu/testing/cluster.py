@@ -67,6 +67,8 @@ class Cluster:
                 backend_factory=backend_factory,
                 standby_count=standby_count,
             )
+            # thread timing must not leak into deterministic runs
+            r.sync_payload_async = False
             r.open()
             self.replicas.append(r)
 
@@ -121,6 +123,7 @@ class Cluster:
             backend_factory=backend_factory or self.backend_factory,
             standby_count=self.standby_count,
         )
+        r.sync_payload_async = False  # deterministic harness
         r.open()
         self.replicas[index] = r
         self.detached.discard(index)

@@ -15,7 +15,14 @@ import random
 from tigerbeetle_tpu.io.network import Address, Handler, Network
 
 
-PARTITION_MODES = ("uniform_size", "isolate_single", "single_link")
+PARTITION_MODES = (
+    "uniform_size",      # a random minority side is cut off
+    "uniform_partition",  # every replica independently coin-flipped to a side
+    "isolate_single",    # one replica cut from everyone
+    "single_link",       # one replica pair's link cut
+    "clog_link",         # one link CLOGGED: packets massively delayed, not
+                         # dropped — bursts of stale traffic on heal
+)
 
 
 class PacketSimulatorOptions:
@@ -54,9 +61,12 @@ class PacketSimulator(Network):
         # as being on the majority side)
         self.partition: set[int] = set()
         # one-way cut replica links (src, dst) — the generalized form the
-        # reference's 5 partition modes/symmetries reduce to (reference:
+        # reference's partition modes/symmetries reduce to (reference:
         # src/testing/packet_simulator.zig:79)
         self.partition_links: set[tuple[int, int]] = set()
+        # clogged links: packets still deliver, tens of ticks late (the
+        # reference's clogging — stale bursts arrive after the heal)
+        self.clogged_links: set[tuple[int, int]] = set()
         self.crashed: set[int] = set()
         self.stats = {"sent": 0, "delivered": 0, "lost": 0, "replayed": 0,
                       "partitioned_drops": 0}
@@ -84,10 +94,11 @@ class PacketSimulator(Network):
     def clear_partitions(self) -> None:
         self.partition = set()
         self.partition_links = set()
+        self.clogged_links = set()
 
     def step_partitions(self) -> None:
         o = self.options
-        if self.partition or self.partition_links:
+        if self.partition or self.partition_links or self.clogged_links:
             if self.rng.random() < o.unpartition_probability:
                 self.clear_partitions()
             return
@@ -105,6 +116,18 @@ class PacketSimulator(Network):
             if symmetric:
                 self.partition_links.add((b, a))
             return
+        elif mode == "clog_link":
+            a, b = self.rng.sample(range(n), 2)
+            self.clogged_links.add((a, b))
+            if symmetric:
+                self.clogged_links.add((b, a))
+            return
+        elif mode == "uniform_partition":
+            # independent coin flip per replica; both sides may be any size
+            # (including empty — then nothing is cut, a valid draw)
+            side = {r for r in range(n) if self.rng.random() < 0.5}
+            if len(side) == n:
+                side = set()
         else:  # uniform_size: a random minority
             k = self.rng.randint(1, max(1, (n - 1) // 2))
             side = set(self.rng.sample(range(n), k))
@@ -134,8 +157,15 @@ class PacketSimulator(Network):
         if o.packet_replay_probability and self.rng.random() < o.packet_replay_probability:
             copies = 2
             self.stats["replayed"] += 1
+        clogged = (
+            self._is_replica(src) and self._is_replica(dst)
+            and (src, dst) in self.clogged_links
+        )
         for _ in range(copies):
             delay = self.rng.randint(o.one_way_delay_min, o.one_way_delay_max)
+            if clogged:  # stale burst: arrives long after the clog heals
+                delay += self.rng.randint(30, 80)
+                self.stats["clogged"] = self.stats.get("clogged", 0) + 1
             self._seq += 1
             heapq.heappush(
                 self.queue,

@@ -107,7 +107,10 @@ def snapshot_to_superblock(
     }
     blobs: list[BlobRef] = []
     off = base
-    if hasattr(ledger, "state"):  # device ledger: HBM tables as blobs
+    # backend seam: device ledger snapshots its HBM leaves as blobs; any
+    # backend with snapshot_bytes (oracle, native engine, sharded mesh
+    # ledger) snapshots one opaque blob
+    if hasattr(ledger, "state") and not hasattr(ledger, "snapshot_bytes"):
         dev = ledger.state
         for name in SNAPSHOT_LEAVES:
             data = np.asarray(dev[name]).tobytes()
@@ -132,7 +135,7 @@ def snapshot_to_superblock(
             # forest's grid blocks are durable before storage.sync() below
             meta["spill"] = ledger.spill.checkpoint_meta()
         assert meta["fault"] == 0, "refusing to checkpoint a faulted ledger"
-    else:  # scalar oracle backend (logic-level simulation): one blob
+    else:  # oracle / native / sharded backend: one opaque blob
         data = ledger.snapshot_bytes()
         assert off + len(data) <= base + area_size, "grid area overflow"
         storage.write(Zone.grid, off, data)
@@ -178,7 +181,7 @@ def restore_from_snapshot(
 ) -> None:
     """Load a checkpoint back into the ledger backend (inverse of
     snapshot_to_superblock; fresh state when the superblock has no blobs)."""
-    if not hasattr(ledger, "state"):  # oracle/native backend
+    if hasattr(ledger, "restore_bytes"):  # oracle/native/sharded backend
         for ref in state.blobs:
             if ref.name != "oracle":
                 raise RuntimeError(
@@ -265,7 +268,7 @@ class DurableLedger:
                 storage,
                 offset=storage.layout.forest_offset,
                 block_count=storage.layout.forest_blocks,
-            ))
+            ), memtable_max=getattr(process, "lsm_memtable_max", 2048))
         self.ledger = DeviceLedger(cluster, process, mode=mode,
                                    forest=self.forest)
         self.sm = StateMachine(self.ledger, cluster)

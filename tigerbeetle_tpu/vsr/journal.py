@@ -219,20 +219,38 @@ class Journal:
     def recover(self) -> dict[int, Header]:
         """Scan both rings; return op -> header for every slot whose prepare
         is intact (the replayable set). Rebuilds the in-memory header mirror
-        from BOTH rings and records faulty slots.
+        from BOTH rings, records faulty slots, and classifies each slot
+        into the decision matrix (reference: src/vsr/journal.zig:374-535):
 
-        Single-replica decision subset of the reference's matrix
-        (reference: src/vsr/journal.zig:374-535):
-        - prepare valid                      -> slot holds prepare.op
-        - prepare torn, redundant valid      -> FAULTY slot: the op's body
-          is lost; `faulty` records it (with replica_count=1 recovery stops
-          at the gap; the reference nacks/repairs it from peers). The
-          redundant header is kept in the mirror so neighbor-sector
-          read-modify-writes don't destroy the evidence.
-        - both torn/empty                    -> empty slot
-        """
+        - valid:       prepare intact, rings agree (or redundant torn — the
+                       prepare's own header wins: torn_header)
+        - faulty:      redundant header survives but the prepare body is
+                       torn — the op is KNOWN, the body repairable from any
+                       acker (`faulty` records it; normal-status WAL scrub
+                       and view-change adoption refetch it)
+        - wrap_stale:  BOTH rings valid but the redundant header carries a
+                       NEWER op for the slot — the newer prepare's write
+                       was lost/rolled back while the previous ring pass's
+                       prepare survives underneath. The redundant header is
+                       the later evidence (it is only ever written AFTER
+                       its prepare landed), so the slot is FAULTY for the
+                       newer op; trusting the stale prepare would advertise
+                       a superseded op in DVCs and could false-nack an
+                       acked one.
+        - misdirected: a checksum-valid prepare whose op does not map to
+                       this slot — the write landed in the wrong place
+                       (reference classifies misdirected reads/writes);
+                       never evidence, the true slot content is lost.
+        - blank:       neither ring holds anything usable.
+
+        `recover_stats` counts the classifications (simulator assertions
+        + observability)."""
         out: dict[int, Header] = {}
         self.faulty: dict[int, int] = {}  # slot -> op whose body is lost
+        self.recover_stats = {
+            "valid": 0, "torn_header": 0, "faulty": 0, "wrap_stale": 0,
+            "misdirected": 0, "blank": 0,
+        }
         raw_headers = self.storage.read(
             Zone.wal_headers, 0,
             (self.slot_count * HEADER_SIZE + SECTOR_SIZE - 1)
@@ -243,26 +261,50 @@ class Journal:
                 Zone.wal_prepares, slot * self.msg_max, self.msg_max
             )
             p_header = Header.from_bytes(praw[:HEADER_SIZE])
-            p_ok = (
+            p_valid = (
                 p_header.valid_checksum()
                 and p_header.command == Command.prepare
-                and self.slot_for_op(p_header.op) == slot
                 and p_header.size <= self.msg_max
-                and p_header.valid_checksum_body(praw[HEADER_SIZE : p_header.size])
+                and p_header.valid_checksum_body(
+                    praw[HEADER_SIZE : p_header.size]
+                )
             )
+            p_here = p_valid and self.slot_for_op(p_header.op) == slot
             off = slot * HEADER_SIZE
-            if p_ok:
-                out[p_header.op] = p_header
-                self._headers[off : off + HEADER_SIZE] = p_header.to_bytes()
-                continue
             r_header = Header.from_bytes(raw_headers[off : off + HEADER_SIZE])
             r_ok = (
                 r_header.valid_checksum()
                 and r_header.command == Command.prepare
                 and self.slot_for_op(r_header.op) == slot
             )
-            if r_ok:  # torn prepare: op known, body lost
+            if p_valid and not p_here:
+                # misdirected write: the prepare belongs elsewhere; fall
+                # back to the redundant ring for THIS slot's evidence
+                self.recover_stats["misdirected"] += 1
+                if r_ok:
+                    self.faulty[slot] = r_header.op
+                    self._headers[off : off + HEADER_SIZE] = (
+                        r_header.to_bytes()
+                    )
+                continue
+            if p_here and (not r_ok or r_header.op <= p_header.op):
+                # the prepare is the newest evidence for the slot
+                out[p_header.op] = p_header
+                self._headers[off : off + HEADER_SIZE] = p_header.to_bytes()
+                self.recover_stats[
+                    "valid" if r_ok and r_header.op == p_header.op
+                    else "torn_header"
+                ] += 1
+                continue
+            if r_ok:
+                # redundant header is the newest evidence; the body for its
+                # op is lost (torn prepare, or a stale wrap underneath)
                 self.faulty[slot] = r_header.op
                 self._headers[off : off + HEADER_SIZE] = r_header.to_bytes()
+                self.recover_stats[
+                    "wrap_stale" if p_here else "faulty"
+                ] += 1
+                continue
+            self.recover_stats["blank"] += 1
         self._headers_durable = bytearray(self._headers)
         return out

@@ -115,7 +115,7 @@ class Replica:
                     storage,
                     offset=storage.layout.forest_offset,
                     block_count=storage.layout.forest_blocks,
-                ))
+                ), memtable_max=getattr(process, "lsm_memtable_max", 2048))
             backend = DeviceLedger(cluster, process, mode=mode,
                                    forest=self.forest)
         if hasattr(backend, "prefetch_results"):
@@ -167,11 +167,16 @@ class Replica:
         # (reference: src/vsr/grid_blocks_missing.zig)
         self._grid_missing: set[int] = set()
         self._scrub_cursor = 0
+        self._wal_scrub_cursor = 1  # continuous WAL repair sweep position
         # group-commit observability (BENCH reports the hit rate): ops
         # committed via a fused device dispatch vs per-op fallback
         self.group_stats = {"fused_ops": 0, "solo_ops": 0}
         # test/simulator observation hook: called on every committed prepare
         self.commit_hook = None
+        # observation hook on every reply built at finalize (hash_log:
+        # reply checksums capture result codes, so kernel nondeterminism
+        # across runs surfaces even when the logs match)
+        self.reply_hook = None
         # optional append-only disaster-recovery log (reference: src/aof.zig,
         # hooked before the reply at src/vsr/replica.zig:3643-3648)
         self.aof = None
@@ -367,6 +372,8 @@ class Replica:
                 and self.ticks % GRID_SCRUB_TICKS == 0
             ):
                 self._scrub_grid()
+            if self.replica_count > 1 and self.ticks % GRID_SCRUB_TICKS == 0:
+                self._scrub_wal()
             if self._grid_missing and self.ticks % RETRY_TICKS == 0:
                 self._request_block_repair(())  # retransmit lost requests
             if (
@@ -867,6 +874,49 @@ class Replica:
         if corrupt:
             self._request_block_repair(corrupt)
 
+    def _scrub_wal(self) -> None:
+        """Continuous WAL repair in NORMAL status (reference: the replica
+        repairs faulty journal slots outside view changes,
+        src/vsr/replica.zig:5248-5654 — not only during adoption): refetch
+        every slot the recovery scan classified TORN (redundant header
+        survives, body lost — vsr/journal.py recover), plus a slow
+        round-robin sweep that re-verifies one live slot per pass to catch
+        in-place media faults after recovery. Fills arrive via the
+        _repair_wanted path in _on_prepare, verified against the mirror
+        header's checksum."""
+        # peer rotation includes the tick so a down peer doesn't pin an op
+        def ask(op: int) -> None:
+            rot = (op + self.ticks // RETRY_TICKS) % (self.replica_count - 1)
+            self._request_prepare(
+                op, (self.replica + 1 + rot) % self.replica_count
+            )
+
+        faulty = getattr(self.journal, "faulty", None)
+        if faulty:
+            for slot, op in list(faulty.items()):
+                h = self.journal.get_header(op)
+                if h is None or h.op != op:
+                    # the ring wrapped: a newer op overwrote the slot — the
+                    # torn op is beyond repair relevance (without this the
+                    # scrub would re-request the superseded op forever)
+                    del faulty[slot]
+                    continue
+                if self.journal.read_prepare(op) is not None:
+                    del faulty[slot]  # healed (repair fill landed)
+                    continue
+                ask(op)  # re-request each pass: lost requests retry
+        # slow sweep: one (1 MiB) slot re-verified per pass
+        lo = max(1, self.op - self.cluster.journal_slot_count + 1)
+        if lo > self.op:
+            return
+        op = self._wal_scrub_cursor
+        if not (lo <= op <= self.op):
+            op = lo
+        h = self.journal.get_header(op)
+        if h is not None and self.journal.read_prepare(op) is None:
+            ask(op)
+        self._wal_scrub_cursor = op + 1 if op < self.op else lo
+
     # ------------------------------------------------------------------
     # state sync: checkpoint shipping for replicas lagging beyond the WAL
     # (reference: src/vsr/sync.zig — a lagging replica jumps to a newer
@@ -876,7 +926,18 @@ class Replica:
     def _sync_checkpoint_payload(self) -> tuple[bytes, int] | None:
         """(full image, checksum) to ship: state + snapshot blobs +
         (spill) forest blocks. Cached per superblock sequence — rebuilding
-        or re-hashing per chunk request would be O(image) each."""
+        or re-hashing per chunk request would be O(image) each.
+
+        With sync_payload_async (production default), the O(checkpoint)
+        read+hash runs on a side thread and requests arriving mid-build get
+        no reply (the lagging peer's tick-cadence retry is the backpressure)
+        — serving a sync must never stall the event loop for the whole
+        image (reference: src/vsr/sync.zig streams trailers in chunks).
+        Deterministic harnesses set sync_payload_async=False (thread timing
+        must not leak into seeded runs). Consistency: the blob areas of the
+        live sequence are immutable (ping-pong), and forest-block reuse is
+        staged until the NEXT checkpoint — a checkpoint advancing mid-build
+        changes the sequence and the stale build is discarded."""
         state = self.superblock.state
         if state is None or state.commit_min == 0:
             return None
@@ -884,6 +945,40 @@ class Replica:
         if cached is not None and cached[0] == state.sequence:
             self._sync_payload_tick = self.ticks
             return cached[1], cached[2]
+        if getattr(self, "sync_payload_async", True):
+            fut = getattr(self, "_sync_payload_fut", None)
+            if fut is not None:
+                if not fut.done():
+                    return None  # still building: the peer retries
+                self._sync_payload_fut = None
+                try:
+                    seq, full, checksum = fut.result()
+                except Exception:
+                    # a failed build (transient IO error on the side
+                    # thread) must not crash the event loop to serve an
+                    # OPTIONAL sync — drop it; the peer's retry rebuilds
+                    return None
+                if seq == state.sequence:
+                    self._sync_payload_cache = (seq, full, checksum)
+                    self._sync_payload_tick = self.ticks
+                    return full, checksum
+                # checkpoint advanced mid-build: fall through, rebuild
+            from concurrent.futures import ThreadPoolExecutor
+
+            if getattr(self, "_sync_executor", None) is None:
+                self._sync_executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="sync-payload"
+                )
+            self._sync_payload_fut = self._sync_executor.submit(
+                self._build_sync_payload, state
+            )
+            return None
+        seq, full, checksum = self._build_sync_payload(state)
+        self._sync_payload_cache = (seq, full, checksum)
+        self._sync_payload_tick = self.ticks
+        return full, checksum
+
+    def _build_sync_payload(self, state) -> tuple[int, bytes, int]:
         from tigerbeetle_tpu.io.storage import Zone
 
         payload = state.to_bytes()
@@ -919,9 +1014,7 @@ class Replica:
         from tigerbeetle_tpu import native
 
         checksum = native.checksum(full)  # hashed ONCE per image, not per chunk
-        self._sync_payload_cache = (state.sequence, full, checksum)
-        self._sync_payload_tick = self.ticks
-        return full, checksum
+        return state.sequence, full, checksum
 
     @property
     def _sync_chunk_size(self) -> int:
@@ -1358,6 +1451,8 @@ class Replica:
         reply.replica = self.replica
         reply.view = self.view
         reply.set_checksum()
+        if self.reply_hook is not None:
+            self.reply_hook(header, reply.checksum_body)
         wire = reply.to_bytes() + reply_body
         tentry = self.client_table.get(header.client)
         if tentry is not None:
