@@ -324,7 +324,7 @@ def _bench_spill_config(stage, out, rng) -> None:
         forest = Forest(Grid(
             MemoryStorage(layout), offset=0, block_count=5760,
             cache_blocks=128,
-        ))
+        ), memtable_max=8192)  # spill-heavy: bigger tables, less churn
         process = ConfigProcess(account_slots_log2=16,
                                 transfer_slots_log2=16)  # 32k-row budget
         ledger = DeviceLedger(process=process, mode="auto", forest=forest)

@@ -80,8 +80,10 @@ def test_in_place_wal_fault_heals_via_slow_sweep():
         64,
     )
     assert r.journal.read_prepare(target) is None
-    # sweep pace: one op per GRID_SCRUB_TICKS; give it a full cycle
-    cluster.run_ticks(8 * (r.op + 2) + 40)
+    # sweep pace: one op per WAL_SWEEP_TICKS; give it a full cycle
+    from tigerbeetle_tpu.vsr.replica import WAL_SWEEP_TICKS
+
+    cluster.run_ticks(WAL_SWEEP_TICKS * (r.op + 2) + 40)
     assert r.journal.read_prepare(target) is not None
     assert r.status == "normal"
 

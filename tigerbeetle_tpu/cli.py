@@ -147,6 +147,12 @@ def cmd_start(args) -> int:
 
         devs = jax.devices()
         if args.shards:
+            if args.shards > len(devs):
+                flags.fatal(
+                    f"--shards {args.shards} but only {len(devs)} device(s) "
+                    "available — a silently smaller mesh would write "
+                    "checkpoints with the wrong shard geometry"
+                )
             devs = devs[: args.shards]
         mesh = Mesh(_np.array(devs), ("shard",))
         backend_factory = lambda: ShardedLedger(  # noqa: E731

@@ -123,7 +123,10 @@ class Groove:
         n = len(rows_u8)
         if n == 0:
             return
-        ts_be = timestamps.astype(">u8").view(np.uint8).reshape(n, TS_SIZE)
+        rows_u8 = np.ascontiguousarray(rows_u8)
+        ts_be = np.ascontiguousarray(
+            timestamps.astype(">u8")
+        ).view(np.uint8).reshape(n, TS_SIZE)
         ts_flat = ts_be.tobytes()
         ts_keys = [
             ts_flat[i * TS_SIZE : (i + 1) * TS_SIZE] for i in range(n)

@@ -42,10 +42,33 @@ FILTER_BITS_PER_KEY = 10
 FILTER_PROBES = 4
 
 
+# Filter format v1: "BF02"-prefixed bits built with the VECTORIZED
+# polynomial hash below (building 10M+ keys through per-key blake2b
+# dominated whole spill cycles). The authoritative version marker is
+# TableInfo.filter_version (persisted in the manifest) — payload sniffing
+# alone could misread a legacy blake2b filter whose first bytes collide
+# with the magic (~2^-32/filter, but a false NEGATIVE would silently skip
+# a table). Legacy version-0 filters keep the blake2b probes.
+FILTER_MAGIC = b"BF02"
+_POLY = 0x100000001B3  # FNV-ish odd multiplier (mod 2^64)
+_MIX1 = 0xFF51AFD7ED558CCD
+_MIX2 = 0xC4CEB9FE1A85EC53
+_M64 = (1 << 64) - 1
+
+
+def _poly_hash_scalar(key: bytes) -> tuple[int, int]:
+    h = 0xCBF29CE484222325
+    for b in key:
+        h = ((h ^ b) * _POLY) & _M64
+    h ^= h >> 33
+    h1 = (h * _MIX1) & _M64
+    h1 ^= h1 >> 29
+    h2 = ((h * _MIX2) & _M64) | 1
+    return h1, h2
+
+
 def _filter_probes(key: bytes, nbits: int):
-    """Deterministic probe positions (blake2b — never Python's salted
-    hash(): filter bytes live in checksummed grid blocks shared across
-    replicas)."""
+    """Legacy (unversioned) probe positions — blake2b."""
     d = hashlib.blake2b(key, digest_size=16).digest()
     h1 = int.from_bytes(d[:8], "little")
     h2 = int.from_bytes(d[8:], "little") | 1
@@ -53,17 +76,52 @@ def _filter_probes(key: bytes, nbits: int):
 
 
 def build_filter(keys, count: int) -> bytes:
+    """Split-block-style filter over fixed-size keys, built VECTORIZED:
+    one polynomial pass over the key byte columns + one scattered
+    bitwise-or per probe (numpy), instead of a Python blake2b per key."""
+    import numpy as np
+
     # multiple of 8 so the query side's len*8 equals the build-side modulus
     nbits = (max(64, count * FILTER_BITS_PER_KEY) + 7) // 8 * 8
-    bits = bytearray(nbits // 8)
-    for key in keys:
-        for p in _filter_probes(key, nbits):
-            bits[p >> 3] |= 1 << (p & 7)
-    return bytes(bits)
+    bits = np.zeros(nbits // 8, dtype=np.uint8)
+    keys = list(keys)
+    if keys:
+        n = len(keys)
+        ksz = len(keys[0])
+        arr = np.frombuffer(b"".join(keys), dtype=np.uint8).reshape(n, ksz)
+        h = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+        poly = np.uint64(_POLY)
+        for j in range(ksz):
+            h = (h ^ arr[:, j].astype(np.uint64)) * poly
+        h ^= h >> np.uint64(33)
+        h1 = h * np.uint64(_MIX1)
+        h1 ^= h1 >> np.uint64(29)
+        h2 = (h * np.uint64(_MIX2)) | np.uint64(1)
+        for i in range(FILTER_PROBES):
+            p = (h1 + np.uint64(i) * h2) % np.uint64(nbits)
+            np.bitwise_or.at(
+                bits, (p >> np.uint64(3)).astype(np.int64),
+                (np.uint8(1) << (p & np.uint64(7)).astype(np.uint8)),
+            )
+    return FILTER_MAGIC + bits.tobytes()
 
 
-def filter_may_contain(filt: bytes, key: bytes) -> bool:
-    nbits = len(filt) * 8
+def filter_may_contain(filt: bytes, key: bytes, version: int = 1) -> bool:
+    if version >= 1 and filt.startswith(FILTER_MAGIC):
+        bits = filt[len(FILTER_MAGIC):]
+        nbits = len(bits) * 8
+        if nbits == 0:
+            return True
+        h1, h2 = _poly_hash_scalar(key)
+        # (h1 + i*h2) wraps mod 2^64 BEFORE the modulus (the vectorized
+        # builder computes in u64; nbits does not divide 2^64)
+        return all(
+            bits[p >> 3] & (1 << (p & 7))
+            for p in (
+                ((h1 + i * h2) & _M64) % nbits for i in range(FILTER_PROBES)
+            )
+        )
+    nbits = len(filt) * 8  # legacy blake2b filter
     if nbits == 0:
         return True
     return all(
@@ -80,6 +138,7 @@ class TableInfo:
     key_max: bytes
     entry_count: int
     filter_address: int = 0  # 0 = no filter (pre-filter manifests)
+    filter_version: int = 0  # 0 = legacy blake2b probes, 1 = BF02 poly
 
     def to_json(self):
         return {
@@ -88,6 +147,7 @@ class TableInfo:
             "key_max": self.key_max.hex(),
             "entry_count": self.entry_count,
             "filter_address": self.filter_address,
+            "filter_version": self.filter_version,
         }
 
     @staticmethod
@@ -98,6 +158,7 @@ class TableInfo:
             key_max=bytes.fromhex(d["key_max"]),
             entry_count=d["entry_count"],
             filter_address=d.get("filter_address", 0),
+            filter_version=d.get("filter_version", 0),
         )
 
 
@@ -258,7 +319,8 @@ class Tree:
             # entirely (reference: src/lsm/bloom_filter.zig consulted in
             # lookup_from_levels_storage)
             if not filter_may_contain(
-                self.grid.read_block(info.filter_address), key
+                self.grid.read_block(info.filter_address), key,
+                version=info.filter_version,
             ):
                 return None
         index = self.grid.read_block(info.index_address)
@@ -322,6 +384,7 @@ class Tree:
             key_min=items[0][0], key_max=items[-1][0],
             entry_count=len(items),
             filter_address=filter_address,
+            filter_version=1,
         )
 
     def _level_budget(self, level: int) -> int:

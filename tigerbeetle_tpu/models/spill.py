@@ -271,7 +271,9 @@ class SpillManager:
             rows_d, ful_d = self.kernels.gather(
                 st["xfer_rows"], st["fulfill"], jnp.asarray(idx_pad)
             )
-            rows = np.asarray(rows_d)[: len(idx)]
+            # ascontiguousarray: some backends (axon) hand back arrays the
+            # later .view(uint8) reinterpretation rejects
+            rows = np.ascontiguousarray(np.asarray(rows_d)[: len(idx)])
             ful = np.asarray(ful_d)[: len(idx)]
             ids_lo = rows[:, 0].astype(np.uint64) | (
                 rows[:, 1].astype(np.uint64) << np.uint64(32)

@@ -301,7 +301,7 @@ class Simulator:
                 grid.cache.remove(a)  # the fault must be visible to reads
                 self.grid_faults += 1
                 return
-            return
+            # no eligible block on this replica this tick: try the next
 
     def _maybe_restart(self, now: int) -> None:
         for i, when in list(self.down.items()):

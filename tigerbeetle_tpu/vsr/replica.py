@@ -65,6 +65,7 @@ VIEW_CHANGE_TICKS = 40  # backup: silence before starting a view change
 RETRY_TICKS = 16  # view-change message retry cadence
 GRID_SCRUB_TICKS = 8  # forest-block scrub cadence (reference: grid scrubber)
 GRID_SCRUB_BLOCKS = 8  # acquired blocks verified per scrub pass
+WAL_SWEEP_TICKS = 64  # in-place-fault WAL re-verify cadence (1 MiB/pass)
 
 # DVC suffix NACK marker: a synthetic header whose `operation` proves the
 # sender's slot for that op is BLANK — it never prepared the op (the
@@ -905,7 +906,11 @@ class Replica:
                     del faulty[slot]  # healed (repair fill landed)
                     continue
                 ask(op)  # re-request each pass: lost requests retry
-        # slow sweep: one (1 MiB) slot re-verified per pass
+        # slow sweep for IN-PLACE media faults (after recovery): one full
+        # 1 MiB slot re-verify per WAL_SWEEP_TICKS — a deliberately low
+        # cadence; the verify is a synchronous read on the event loop
+        if self.ticks % WAL_SWEEP_TICKS != 0:
+            return
         lo = max(1, self.op - self.cluster.journal_slot_count + 1)
         if lo > self.op:
             return
@@ -963,15 +968,25 @@ class Replica:
                     self._sync_payload_tick = self.ticks
                     return full, checksum
                 # checkpoint advanced mid-build: fall through, rebuild
-            from concurrent.futures import ThreadPoolExecutor
+            # a daemon thread + bare Future (not a ThreadPoolExecutor):
+            # replicas have no close() hook, and a pool's non-daemon worker
+            # would outlive the replica and stall interpreter exit behind
+            # an O(checkpoint) build
+            import threading
+            from concurrent.futures import Future
 
-            if getattr(self, "_sync_executor", None) is None:
-                self._sync_executor = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="sync-payload"
-                )
-            self._sync_payload_fut = self._sync_executor.submit(
-                self._build_sync_payload, state
-            )
+            fut = Future()
+
+            def _build(state=state, fut=fut):
+                try:
+                    fut.set_result(self._build_sync_payload(state))
+                except BaseException as e:  # surfaced (and dropped) above
+                    fut.set_exception(e)
+
+            threading.Thread(
+                target=_build, daemon=True, name="sync-payload"
+            ).start()
+            self._sync_payload_fut = fut
             return None
         seq, full, checksum = self._build_sync_payload(state)
         self._sync_payload_cache = (seq, full, checksum)
