@@ -342,10 +342,33 @@ def _bench_spill_config(stage, out, rng) -> None:
         nbatches = int(os.environ.get("BENCH_SPILL_BATCHES", 24))
         n_pend = max(2, nbatches // 6)  # oldest batches: spilled first
         n_post = n_pend // 2  # posts of (by then) SPILLED pendings
-        warm = build_transfers(rng, 5_000_000, BATCH)
+        # Warm until a spill CYCLE and a RELOAD have both run: the cycle's
+        # kernels (ts/occ scan, gather, reload, post tier) otherwise
+        # compile inside the timed loop — tens of seconds of remote
+        # compiles booked against the steady-state number.
+        warm_pend = build_transfers(rng, 4_000_000, BATCH)
+        warm_pend["flags"] = 2
         ts2 += BATCH
         ledger.drain(ledger.execute_async(
-            Operation.create_transfers, ts2, warm
+            Operation.create_transfers, ts2, warm_pend
+        ))
+        wg = 0
+        while ledger.spill.stats["cycles"] < 1 and wg < 8:
+            warm = build_transfers(rng, 4_500_000 + wg * BATCH, BATCH)
+            ts2 += BATCH
+            ledger.drain(ledger.execute_async(
+                Operation.create_transfers, ts2, warm
+            ))
+            wg += 1
+        warm_post = np.zeros(BATCH, dtype=warm_pend.dtype)
+        warm_post["id_lo"] = np.arange(
+            4_900_000, 4_900_000 + BATCH, dtype=np.uint64
+        )
+        warm_post["pending_id_lo"] = warm_pend["id_lo"]
+        warm_post["flags"] = 4  # posts of spilled pendings: reload + tier
+        ts2 += BATCH
+        ledger.drain(ledger.execute_async(
+            Operation.create_transfers, ts2, warm_post
         ))
         pend_bodies = []
         t0 = time.perf_counter()
@@ -407,7 +430,7 @@ def bench_e2e(stage) -> dict:
 
     log = lambda *a: print("[e2e]", *a, file=sys.stderr)  # noqa: E731
     n = int(os.environ.get("BENCH_E2E_TRANSFERS", 2_000_000))
-    clients = int(os.environ.get("BENCH_E2E_CLIENTS", 8))
+    clients = int(os.environ.get("BENCH_E2E_CLIENTS", 10))
     try:
         with stage("e2e_durable"):
             out = run_e2e(

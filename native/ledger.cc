@@ -226,6 +226,33 @@ struct Table {
     }
   }
 
+  // find + remember the insert slot in ONE probe chain (the hot path does
+  // a miss-lookup immediately followed by an insert of the same key).
+  size_t find_or_prepare(u128 id, size_t *insert_slot) {
+    size_t i = hash_u128(id) & mask;
+    size_t tomb = NIL;
+    while (true) {
+      if (keys[i] == id && st[i] == 1) return i;
+      if (st[i] == 0) {
+        *insert_slot = tomb != NIL ? tomb : i;
+        return NIL;
+      }
+      if (st[i] == 2 && tomb == NIL) tomb = i;
+      i = (i + 1) & mask;
+    }
+  }
+
+  // insert at a slot returned by find_or_prepare (key known absent).
+  void insert_at(size_t i, u128 id, const Row &r) {
+    if (st[i] != 2) used++;
+    st[i] = 1;
+    keys[i] = id;
+    rows[i] = r;
+    live++;
+  }
+
+  bool needs_grow() const { return (used + 1) * 2 > rows.size(); }
+
   // Slot to insert `id` at (reuses tombstones); id must be absent.
   size_t slot_for_insert(u128 id) {
     size_t i = hash_u128(id) & mask;
@@ -582,11 +609,9 @@ uint32_t post_or_void(Ledger &L, const TransferRow &t) {
     dr.set_debits_posted(dr.debits_posted() + amount);
     cr.set_credits_posted(cr.credits_posted() + amount);
   }
-  // re-find: the transfer insert may have grown nothing, but the account
-  // table is stable here (no account inserts since drs/crs) — still,
-  // refresh via find for safety against future edits
-  L.accounts.rows[L.accounts.find(dr.id())] = dr;
-  L.accounts.rows[L.accounts.find(cr.id())] = cr;
+  // drs/crs stay valid: only the TRANSFER/posted tables changed above
+  L.accounts.rows[drs] = dr;
+  L.accounts.rows[crs] = cr;
 
   L.commit_timestamp = t.timestamp;
   return TR_ok;
@@ -630,8 +655,12 @@ uint32_t create_transfer(Ledger &L, const TransferRow &t) {
     return TR_transfer_must_have_the_same_ledger_as_accounts;
 
   // An existing transfer must not influence overflow/limit checks
-  // (reference: src/state_machine.zig:823-824).
-  size_t es = L.transfers.find(id);
+  // (reference: src/state_machine.zig:823-824). One probe chain resolves
+  // both the exists check and (on miss) the insert slot — but the slot is
+  // only reusable if no grow intervenes (checked below).
+  size_t ins = NIL;
+  if (L.transfers.needs_grow()) L.transfers.grow();
+  size_t es = L.transfers.find_or_prepare(id, &ins);
   if (es != NIL) {
     const TransferRow &e = L.transfers.rows[es];
     // reference: src/state_machine.zig:886-905
@@ -707,7 +736,7 @@ uint32_t create_transfer(Ledger &L, const TransferRow &t) {
   TransferRow t2 = t;
   t2.set_amount(amount);
   scope_note_transfer(L, id);
-  L.transfers.insert(id, t2);
+  L.transfers.insert_at(ins, id, t2);  // slot from find_or_prepare above
 
   scope_note_account(L, dr_id);
   scope_note_account(L, cr_id);
@@ -718,8 +747,9 @@ uint32_t create_transfer(Ledger &L, const TransferRow &t) {
     dr.set_debits_posted(dr.debits_posted() + amount);
     cr.set_credits_posted(cr.credits_posted() + amount);
   }
-  L.accounts.rows[L.accounts.find(dr_id)] = dr;
-  L.accounts.rows[L.accounts.find(cr_id)] = cr;
+  // drs/crs stay valid: nothing touched the ACCOUNT table since find
+  L.accounts.rows[drs] = dr;
+  L.accounts.rows[crs] = cr;
 
   L.commit_timestamp = t.timestamp;
   return TR_ok;

@@ -109,3 +109,18 @@ def test_simulation_five_replicas():
         ),
     )
     assert stats["committed_ops"] > 20
+
+
+def test_simulation_with_standbys():
+    """Standbys under chaos (reference: VOPR runs standbys too): they
+    follow the log (streamed prepares), never vote, crash/restart freely
+    outside quorum accounting, and converge to the same committed state."""
+    stats = run_simulation(
+        29,
+        ticks=900,
+        replica_count=3,
+        standby_count=2,
+        n_clients=2,
+        crash_probability=0.004,
+    )
+    assert stats["committed_ops"] > 10

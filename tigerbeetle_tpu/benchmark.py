@@ -321,6 +321,18 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
         f"timing {n_work} batches")
 
     # -- timed phase: each session keeps one batch in flight --
+    import selectors as _selectors
+
+    # One wakeup selector over every session's socket: the idle path blocks
+    # until ANY reply bytes arrive instead of sleep-polling (time.sleep's
+    # ~0.5 ms real granularity dominated the driver and starved the server).
+    wake = _selectors.DefaultSelector()
+    for s in sessions:
+        for conn in s.bus.conns.values():
+            try:
+                wake.register(conn.sock, _selectors.EVENT_READ)
+            except (KeyError, ValueError):
+                pass
     lat_ms: list[float] = []
     failures = 0
     inflight: dict[int, float] = {}
@@ -364,7 +376,24 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
                 raise TimeoutError(
                     f"benchmark stalled at batch {done_batches}/{n_work}"
                 )
-            time.sleep(0.0001)
+            # reconcile registrations: a dropped+redialed connection has a
+            # NEW socket that must wake the idle path too
+            regged = {k.fileobj for k in wake.get_map().values()}
+            current = {
+                c.sock for s in sessions for c in s.bus.conns.values()
+            }
+            for sock in current - regged:
+                try:
+                    wake.register(sock, _selectors.EVENT_READ)
+                except (KeyError, ValueError, OSError):
+                    pass
+            for sock in regged - current:
+                try:
+                    wake.unregister(sock)
+                except (KeyError, ValueError, OSError):
+                    pass
+            wake.select(timeout=0.002)  # woken by the first reply bytes
+    wake.close()
     wall = time.monotonic() - t_start
     n_timed = done_batches * batch
     assert failures == 0, f"{failures} transfers failed"

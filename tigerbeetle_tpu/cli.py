@@ -48,7 +48,8 @@ class StartArgs:
     transfer_slots_log2: int = 24
     aof: str = ""  # append-only disaster-recovery log path
     statsd: str = ""  # statsd host:port
-    commit_window: int = 8  # async device commits in flight (0 = sync)
+    commit_window: int = 16  # async commits in flight (0 = sync); a full
+    # GROUP_MAX fused group stays un-drained while the next one arrives
     # Commit backend: "native" = the C++ host engine (native/ledger.cc —
     # the durable hot path; this environment's tunneled TPU degrades
     # permanently on any device->host fetch, see models/native_ledger.py),

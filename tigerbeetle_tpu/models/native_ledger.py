@@ -81,13 +81,16 @@ class NativeLedger:
         return self._executor.submit(fn, *args)
 
     def __del__(self):
-        ex = getattr(self, "_executor", None)
-        if ex is not None:
-            ex.shutdown(wait=True)
-        h = getattr(self, "_h", None)
-        if h:
-            self._lib.tb_ledger_free(h)
-            self._h = None
+        try:
+            ex = getattr(self, "_executor", None)
+            if ex is not None:
+                ex.shutdown(wait=True)
+            h = getattr(self, "_h", None)
+            if h:
+                self._lib.tb_ledger_free(h)
+                self._h = None
+        except Exception:
+            pass  # interpreter teardown: modules may already be gone
 
     # -- lifecycle (oracle-compatible) --
 

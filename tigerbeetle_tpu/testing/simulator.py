@@ -100,6 +100,7 @@ class Simulator:
         self,
         seed: int,
         replica_count: int = 3,
+        standby_count: int = 0,
         n_clients: int = 2,
         ticks: int = 1500,
         cluster: ConfigCluster = TEST_CLUSTER,
@@ -133,10 +134,12 @@ class Simulator:
         self.superblock_fault_probability = superblock_fault_probability
         self.grid_fault_probability = grid_fault_probability
         self.backend_factory = backend_factory
-        self.replica_count = replica_count
+        self.replica_count = replica_count  # ACTIVE replicas (quorums)
+        self.standby_count = standby_count
+        self.total_replicas = replica_count + standby_count
 
         self.net = PacketSimulator(
-            seed * 31 + 1, replica_count,
+            seed * 31 + 1, self.total_replicas,
             options or PacketSimulatorOptions(
                 packet_loss_probability=0.02,
                 packet_replay_probability=0.02,
@@ -147,16 +150,16 @@ class Simulator:
                                  forest_blocks=forest_blocks)
         self.times = [
             DeterministicTime(offset_ns=self.rng.randint(-50, 50) * 1_000_000)
-            for _ in range(replica_count)
+            for _ in range(self.total_replicas)
         ]
         self.storages = []
         self.replicas: list[Replica] = []
         # god's-eye committed history per replica:
         # op -> (checksum, operation, timestamp, body)
         self.histories: list[dict[int, tuple]] = [
-            {} for _ in range(replica_count)
+            {} for _ in range(self.total_replicas)
         ]
-        for i in range(replica_count):
+        for i in range(self.total_replicas):
             storage = MemoryStorage(self.layout, seed=seed * 97 + i)
             format_data_file(storage, cluster)
             self.storages.append(storage)
@@ -183,6 +186,7 @@ class Simulator:
             i, self.replica_count, self.storages[i], self.net, self.times[i],
             self.cluster_config, self.process_config,
             backend_factory=self.backend_factory,
+            standby_count=self.standby_count,
         )
         hist = self.histories[i]
 
@@ -206,12 +210,16 @@ class Simulator:
     # -- fault scheduling --
 
     def _maybe_crash(self, now: int) -> None:
-        alive = [i for i in range(self.replica_count) if i not in self.down]
+        alive = [i for i in range(self.total_replicas) if i not in self.down]
+        # quorum safety counts ACTIVE replicas only; standbys (index >=
+        # replica_count) may crash freely — they hold no votes
+        active_down = sum(1 for i in self.down if i < self.replica_count)
         max_down = (self.replica_count - 1) // 2
-        if (
-            len(self.down) < max_down
-            and self.rng.random() < self.crash_probability
-        ):
+        if self.rng.random() < self.crash_probability:
+            if active_down >= max_down:
+                alive = [i for i in alive if i >= self.replica_count]
+                if not alive:
+                    return
             victim = self.rng.choice(alive)
             self.crashes += 1
             if self.rng.random() < self.torn_write_probability:
@@ -236,6 +244,9 @@ class Simulator:
         op = victim.op
         if op < 1 or victim.journal.read_prepare(op) is None:
             return
+        # survivors must be VOTERS: every repair path fetches from active
+        # replicas only, so a copy surviving solely on a standby is
+        # unreachable — tearing the last voter copy would wedge the cluster
         survivors = any(
             self.replicas[j].journal.read_prepare(op) is not None
             for j in range(self.replica_count)
@@ -267,7 +278,7 @@ class Simulator:
             return
         if self.rng.random() >= self.grid_fault_probability:
             return
-        alive = [i for i in range(self.replica_count) if i not in self.down]
+        alive = [i for i in range(self.total_replicas) if i not in self.down]
         self.rng.shuffle(alive)
         from tigerbeetle_tpu.lsm.grid import BLOCK_SIZE
 
@@ -354,7 +365,7 @@ class Simulator:
         op can vanish from all logs."""
         others_min = min(
             self.replicas[j].commit_min
-            for j in range(self.replica_count)
+            for j in range(self.replica_count)  # repair sources: voters
             if j != i
         )
         if others_min < 1:

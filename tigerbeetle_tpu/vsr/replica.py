@@ -344,7 +344,10 @@ class Replica:
     def tick(self) -> None:
         self.ticks += 1
         self.pump_commits()  # deferred group commits (event-loop safety)
-        self.flush_commits()  # bound reply latency to one tick worst-case
+        # finalize whatever results have LANDED (never block the tick on
+        # in-flight device compute; the idle-loop flush and the next ticks
+        # drain the rest as it lands)
+        self.flush_commits(only_ready=True)
         if self.status == "normal":
             if self.is_primary:
                 if self.ticks % HEARTBEAT_TICKS == 0:
@@ -1248,7 +1251,7 @@ class Replica:
                     d["wal"] = entry.get("wal")
                     self._inflight.append(d)
                     self.group_stats["solo_ops"] += 1
-                    self.flush_commits(keep=self.commit_window)
+                    self.flush_commits(keep=self.commit_window, only_ready=True)
                 else:
                     reply_wire = self._commit_prepare(header, body)
                     if reply_wire is not None:
@@ -1305,7 +1308,7 @@ class Replica:
             self.commit_checksum = h.checksum
             del self.pipeline[h.op]
         self.group_stats["fused_ops"] += len(run)
-        self.flush_commits(keep=self.commit_window)
+        self.flush_commits(keep=self.commit_window, only_ready=True)
         return True
 
     def _on_commit(self, header: Header) -> None:
@@ -1355,7 +1358,7 @@ class Replica:
             try:
                 if self.commit_window > 0:
                     self._inflight.append(self._commit_dispatch(header, body))
-                    self.flush_commits(keep=self.commit_window)
+                    self.flush_commits(keep=self.commit_window, only_ready=True)
                 else:
                     self._commit_prepare(header, body)
             except GridBlockCorrupt as e:
@@ -1488,11 +1491,53 @@ class Replica:
                     self.client_replies.write(tentry["slot"], wire)
         return wire
 
-    def flush_commits(self, keep: int = 0) -> None:
+    @staticmethod
+    def _handle_ready(h) -> bool:
+        """Readiness probe for a commit handle (shared by the event loop's
+        commits_ready and the non-blocking flush)."""
+        if h is None or isinstance(h, bytes):
+            return True
+        p = h[1]
+        if hasattr(p, "is_ready"):
+            return bool(p.is_ready())
+        probe = getattr(p, "summary", None)
+        if probe is None and getattr(p, "group", None) is not None:
+            probe = p.group.summary
+        if probe is None:
+            probe = p.results
+        is_ready = getattr(probe, "is_ready", None)
+        return bool(is_ready()) if is_ready is not None else True
+
+    def _entry_ready(self, entry: dict) -> bool:
+        wal = entry.get("wal")
+        if wal is not None and not wal.done():
+            return False  # finalize would block on the WAL fsync
+        return self._handle_ready(entry["handle"])
+
+    def flush_commits(self, keep: int = 0, only_ready: bool = False) -> None:
         """Finalize queued async commits (oldest first) until at most
         `keep` remain in flight. The event loop calls this when the bus has
         no more incoming frames; _maybe_commit_pipeline calls it with
-        keep=commit_window to bound the window."""
+        keep=commit_window AND only_ready=True — the dispatch path must not
+        BLOCK on its own group's device compute (that serialized recv of
+        the next window behind execution of this one). A hard cap of
+        4x keep still blocks to bound the in-flight window."""
+        if only_ready:
+            hard_cap = 4 * keep if keep else (1 << 30)
+            while (
+                len(self._inflight) > keep
+                and (
+                    self._entry_ready(self._inflight[0])
+                    or len(self._inflight) > hard_cap
+                )
+            ):
+                entry = self._inflight.popleft()
+                wire = self._commit_finalize(entry)
+                if wire is not None and entry["to_client"]:
+                    self.network.send(
+                        self.replica, entry["header"].client, wire
+                    )
+            return
         n_final = len(self._inflight) - keep
         if n_final <= 0:
             return
@@ -1525,19 +1570,7 @@ class Replica:
         mid-compute would serialize a round trip per batch)."""
         if not self._inflight:
             return False
-        h = self._inflight[-1]["handle"]
-        if h is None or isinstance(h, bytes):
-            return True
-        p = h[1]
-        if hasattr(p, "is_ready"):  # native pending: probes itself
-            return bool(p.is_ready())
-        probe = getattr(p, "summary", None)
-        if probe is None and getattr(p, "group", None) is not None:
-            probe = p.group.summary
-        if probe is None:
-            probe = p.results
-        is_ready = getattr(probe, "is_ready", None)
-        return bool(is_ready()) if is_ready is not None else True
+        return self._handle_ready(self._inflight[-1]["handle"])
 
     # ------------------------------------------------------------------
     # view change (reference: src/vsr/replica.zig:1595-1924)
