@@ -409,51 +409,89 @@ def _bench_spill_config(stage, out, rng) -> None:
         )
 
 
+def _median_e2e(stage, name: str, n_runs: int, log, **kw) -> dict:
+    """run_e2e N times (fresh server each), report the median with per-run
+    values + spread (round-4 verdict: single samples hid a 30%+ swing).
+    Dual-mode runs must ALL verify their device shadow."""
+    from tigerbeetle_tpu.benchmark import run_e2e
+
+    dual = "+" in kw.get("backend", "native")
+    runs, shadows, last = [], [], None
+    for i in range(n_runs):
+        with stage(f"{name}_{i}"):
+            last = run_e2e(log=log, **kw)
+        runs.append(last["durable_tps"])
+        if dual:
+            # a run whose server died before printing [stats] has no
+            # device_shadow at all — that is an UNVERIFIED run, not a
+            # skippable one
+            shadows.append(
+                last.get("device_shadow", {}).get("verified")
+            )
+    med = float(np.median(runs))
+    out = dict(last)
+    out["durable_tps"] = round(med, 1)
+    out["durable_runs"] = [round(x, 1) for x in runs]
+    out["durable_spread"] = (
+        round((max(runs) - min(runs)) / med, 4) if med else None
+    )
+    if dual:
+        out["shadow_verified_all"] = all(v is True for v in shadows)
+    return out
+
+
 def bench_e2e(stage) -> dict:
     """The durable, through-consensus numbers: format a data file, start a
     REAL replica process (WAL on), drive create_transfers through TCP
     session clients at batch=8190 and verify conservation over the wire —
     the reference's actual measurement protocol (reference:
-    scripts/benchmark.sh:34-78, src/benchmark.zig:23-73). Three runs:
+    scripts/benchmark.sh:34-78, src/benchmark.zig:23-73). Three workloads,
+    each median-of-N over fresh server processes:
 
-    - native backend, simple transfers (the headline durable_tps — the
-      C++ host engine is the durable commit path, native/ledger.cc);
-    - native backend, two-phase-heavy (pend->post pairs; the workload the
-      per-op fallback used to hide);
-    - device backend, short run (the TPU-commit through-stack number —
-      honest about this environment's post-d2h degraded transport, see
+    - DUAL backend (native+device), simple transfers: the headline
+      durable_tps. The C++ engine serves replies while the TPU applies the
+      same prepares asynchronously (h2d only, models/dual_ledger.py);
+      shutdown verifies device state bit-exact (reply-code digests +
+      state fingerprints) — the TPU holds real durable state without a
+      d2h in the timed path.
+    - DUAL backend, two-phase-heavy (pend->post pairs);
+    - device backend, short run (replies THROUGH the TPU — honest about
+      this environment's post-d2h degraded transport, see
       models/native_ledger.py).
 
-    MUST run before this process touches JAX: the device-backend server
-    subprocess owns the TPU chip."""
-    from tigerbeetle_tpu.benchmark import run_e2e
-
+    MUST run before this process touches JAX: the server subprocesses own
+    the TPU chip."""
     log = lambda *a: print("[e2e]", *a, file=sys.stderr)  # noqa: E731
     n = int(os.environ.get("BENCH_E2E_TRANSFERS", 2_000_000))
+    n_runs = int(os.environ.get("BENCH_E2E_RUNS", 3))
     clients = int(os.environ.get("BENCH_E2E_CLIENTS", 10))
     try:
-        with stage("e2e_durable"):
-            out = run_e2e(
-                n_accounts=N_ACCOUNTS, n_transfers=n, clients=clients,
-                log=log,
-            )
+        out = _median_e2e(
+            stage, "e2e_durable", n_runs, log,
+            n_accounts=N_ACCOUNTS, n_transfers=n, clients=clients,
+            backend="native+device",
+        )
     except Exception as e:  # never sink the kernel benchmark
         print(f"[e2e] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
         return {"durable_tps": 0.0, "error": f"{type(e).__name__}: {e}"}
     try:
-        with stage("e2e_two_phase"):
-            tp = run_e2e(
-                n_accounts=N_ACCOUNTS,
-                n_transfers=int(os.environ.get("BENCH_E2E_TP", 1_000_000)),
-                clients=clients, workload="two_phase", log=log,
-            )
+        tp = _median_e2e(
+            stage, "e2e_two_phase", n_runs, log,
+            n_accounts=N_ACCOUNTS,
+            n_transfers=int(os.environ.get("BENCH_E2E_TP", 1_000_000)),
+            clients=clients, workload="two_phase", backend="native+device",
+        )
         out["two_phase"] = tp
         out["durable_two_phase_tps"] = tp["durable_tps"]
+        out["durable_two_phase_runs"] = tp["durable_runs"]
+        out["durable_two_phase_spread"] = tp["durable_spread"]
     except Exception as e:
         out["two_phase"] = {"error": f"{type(e).__name__}: {e}"}
         print(f"[e2e two-phase] FAILED: {e}", file=sys.stderr)
     try:
         with stage("e2e_device"):
+            from tigerbeetle_tpu.benchmark import run_e2e
+
             dv = run_e2e(
                 n_accounts=N_ACCOUNTS,
                 n_transfers=int(os.environ.get("BENCH_E2E_DEV", 200_000)),
@@ -692,40 +730,43 @@ def main() -> None:
         f"p50={lat[2]:.2f} p75={lat[3]:.2f} p100={lat[4]:.2f}",
         file=sys.stderr,
     )
+    # The COMPACT headline (the driver's tail capture parses the LAST stdout
+    # line; round 4's nested sub-objects grew it past the capture window and
+    # the artifact recorded "parsed": null). Full detail — per-run durable
+    # metrics, server stats, tracked configs — goes to BENCH_DETAIL.json
+    # next to this script plus stderr.
+    detail = {"durable": e2e, "configs": configs, "stages_s": {
+        k: round(v, 2) for k, v in stages.items()
+    }}
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAIL.json")
+    with open(detail_path, "w") as f:
+        json.dump(detail, f, indent=1)
+    print("detail: " + json.dumps(detail), file=sys.stderr)
     print(
         json.dumps(
             {
-                "metric": "create_transfers throughput, batch=8190, 10k accounts, "
-                f"{n_timed} transfers (device-generated ingest; "
-                "full commit kernel, verified conservation + result codes; "
-                "median of 5 timed segments)",
+                "metric": "create_transfers transfers/s, batch=8190, 10k "
+                "accounts (TPU commit kernel, device-generated protocol "
+                "workload, conservation+codes verified; median of "
+                f"{len(seg_runs)} segments; detail in BENCH_DETAIL.json)",
                 "value": round(flagship_tps, 1),
                 "unit": "transfers/s",
                 "vs_baseline": round(flagship_tps / BASELINE_TPS, 4),
                 "flagship_runs": [round(x, 1) for x in seg_runs],
                 "flagship_spread": flagship_spread,
-                "flagship_spread_note": "segment spread tracks the REMOTE "
-                "dispatch path's launch latency (tunneled chip), measured "
-                "directly before/after the timed segments:",
                 "dispatch_us_per_launch": [
                     dispatch_us_before, dispatch_us_after
                 ],
                 "latency_ms_p00_p25_p50_p75_p100": [round(x, 2) for x in lat],
                 "ingest_tps": round(ingest_tps, 1),
-                "ingest_note": f"host-upload path over the ~143 MiB/s tunnel, "
-                f"{n_ingest} transfers at 128 B each",
                 "durable_tps": e2e.get("durable_tps", 0.0),
-                "durable_note": "through the FULL stack: real replica process "
-                "(native C++ commit engine), WAL + consensus + TCP clients at "
-                "batch=8190, conservation verified over the wire (the "
-                "BASELINE measurement protocol)",
+                "durable_spread": e2e.get("durable_spread"),
                 "durable_two_phase_tps": e2e.get("durable_two_phase_tps", 0.0),
+                "durable_shadow_verified_all": e2e.get("shadow_verified_all"),
                 "durable_device_tps": e2e.get("durable_device_tps", 0.0),
-                "group_commit_hit_rate": e2e.get(
-                    "device_backend", {}
-                ).get("group_commit_hit_rate", 0.0),
-                "durable": e2e,
-                "configs": configs,
+                "group_commit_hit_rate": e2e.get("group_commit_hit_rate", 0.0),
+                "spill_active_tps": configs.get("spill_active_tps", 0.0),
             }
         )
     )

@@ -890,6 +890,75 @@ uint64_t tb_ledger_lookup(void *h, uint8_t op, const uint8_t *ids,
   return found;
 }
 
+// Group execute (the fused-commit seam): k batches of `op` events applied
+// back to back in ONE worker call — the replica fuses a quorum-ready run of
+// prepares the way the reference pipelines commits (reference:
+// src/vsr/replica.zig:3263-3315 commit_pipeline). events_k[j] points at
+// batch j's ns[j] contiguous 128-byte rows; out_k[j] receives its dense
+// codes; fails[j] its non-ok count. Returns 0, or -1 on invalid arguments.
+int64_t tb_ledger_execute_group(void *h, uint8_t op,
+                                const uint8_t *const *events_k,
+                                const uint32_t *ns, const uint64_t *tss,
+                                uint32_t k, uint32_t *const *out_k,
+                                int64_t *fails) {
+  for (uint32_t j = 0; j < k; j++) {
+    int64_t f = tb_ledger_execute(h, op, events_k[j], ns[j], tss[j], out_k[j]);
+    if (f < 0) return -1;
+    fails[j] = f;
+  }
+  return 0;
+}
+
+// --- state fingerprint (the dual-commit parity seam) ---
+// Order-independent digest over the LIVE table contents: sum (mod 2^64) of
+// a per-row hash of the 128-byte wire image, so two engines with different
+// slot layouts (this host table vs the device hash table) agree iff their
+// logical row sets are bit-identical. The SAME function is implemented in
+// JAX over the device tables (models/ledger.py state_fingerprint) — any
+// constant here changes both or the dual-commit verification breaks loudly.
+
+static inline uint64_t fp_mix(uint64_t x) {
+  x ^= x >> 33;
+  x *= 0xFF51AFD7ED558CCDull;
+  x ^= x >> 33;
+  x *= 0xC4CEB9FE1A85EC53ull;
+  x ^= x >> 33;
+  return x;
+}
+
+static inline uint64_t fp_row(const uint32_t *w) {
+  uint64_t hsh = 0x9E3779B97F4A7C15ull;
+  for (int i = 0; i < 32; i++) {
+    hsh ^= (uint64_t)w[i] * 0xC2B2AE3D27D4EB4Full;
+    hsh = ((hsh << 27) | (hsh >> 37)) * 0x9E3779B97F4A7C15ull +
+          0x165667B19E3779F9ull;
+  }
+  return fp_mix(hsh);
+}
+
+// out8: [accounts_fp, transfers_fp, accounts_live, transfers_live,
+//        posted_live, commit_timestamp, 0, 0]
+void tb_ledger_fingerprint(void *h, uint64_t *out8) {
+  Ledger &L = *(Ledger *)h;
+  uint64_t afp = 0, tfp = 0;
+  for (size_t i = 0; i < L.accounts.rows.size(); i++) {
+    if (L.accounts.st[i] == 1)
+      afp += fp_row((const uint32_t *)&L.accounts.rows[i]);
+  }
+  for (size_t i = 0; i < L.transfers.rows.size(); i++) {
+    if (L.transfers.st[i] == 1)
+      tfp += fp_row((const uint32_t *)&L.transfers.rows[i]);
+  }
+  out8[0] = afp;
+  out8[1] = tfp;
+  out8[2] = L.accounts.live;
+  out8[3] = L.transfers.live;
+  out8[4] = L.posted.live;
+  out8[5] = L.commit_timestamp;
+  out8[6] = 0;
+  out8[7] = 0;
+}
+
 // counts: [n_accounts, n_transfers, n_posted, commit_timestamp]
 void tb_ledger_counts(void *h, uint64_t *out4) {
   Ledger &L = *(Ledger *)h;

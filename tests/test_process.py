@@ -40,17 +40,31 @@ def _spawn_server(path: str, port: int, aof: str | None = None):
     if aof:
         cmd += ["--aof", aof]
     cmd.append(path)
-    env = dict(os.environ, TB_JAX_PLATFORM="cpu", PYTHONPATH=REPO)
+    env = dict(os.environ, TB_JAX_PLATFORM="cpu", PYTHONPATH=REPO,
+               TB_PARENT_WATCHDOG="1")
     proc = subprocess.Popen(
-        cmd, cwd=REPO, env=env,
+        cmd, cwd=REPO, env=env, start_new_session=True,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     line = proc.stdout.readline()  # blocks until "listening" (or crash)
     if "listening" not in line:
         rest = proc.stdout.read()
-        proc.kill()
+        _kill_group(proc)
         raise AssertionError(f"server failed to start: {line}{rest}")
     return proc
+
+
+def _kill_group(proc) -> None:
+    """Kill the server's whole process group (spawned with
+    start_new_session=True, so pgid == pid) and reap it; leaked servers
+    from partial teardowns used to survive the suite and burn CPU."""
+    from tigerbeetle_tpu.benchmark import kill_process_group
+
+    kill_process_group(proc)
+    try:
+        proc.wait(timeout=10)
+    except Exception:
+        pass
 
 
 @pytest.fixture(scope="module")
@@ -68,9 +82,9 @@ def server(tmp_path_factory):
     )
     assert fmt.returncode == 0, fmt.stderr
     proc = _spawn_server(path, port, aof=aof)
-    yield {"proc": proc, "path": path, "port": port, "aof": aof}
-    if proc.poll() is None:
-        proc.kill()
+    state = {"proc": proc, "path": path, "port": port, "aof": aof}
+    yield state
+    _kill_group(state["proc"])  # the kill/restart test replaces "proc"
 
 
 def test_native_client_end_to_end(server):
@@ -149,13 +163,15 @@ def test_three_replica_tcp_cluster(tmp_path):
                 "--transfer-slots-log2", "12",
                 str(tmp_path / f"r{i}.tigerbeetle"),
             ]
-            env = dict(os.environ, TB_JAX_PLATFORM="cpu", PYTHONPATH=REPO)
+            env = dict(os.environ, TB_JAX_PLATFORM="cpu", PYTHONPATH=REPO,
+               TB_PARENT_WATCHDOG="1")
             p = subprocess.Popen(cmd, cwd=REPO, env=env,
+                                 start_new_session=True,
                                  stdout=subprocess.PIPE,
                                  stderr=subprocess.STDOUT, text=True)
+            procs.append(p)
             line = p.stdout.readline()
             assert "listening" in line, line + (p.stdout.read() or "")
-            procs.append(p)
 
         client = NativeClient(addresses)  # rotates to find the primary
         assert client.create_accounts(
@@ -171,8 +187,7 @@ def test_three_replica_tcp_cluster(tmp_path):
         client.close()
     finally:
         for p in procs:
-            if p.poll() is None:
-                p.kill()
+            _kill_group(p)
 
 
 def test_statsd_and_tracer_units(tmp_path):

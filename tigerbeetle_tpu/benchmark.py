@@ -45,6 +45,18 @@ def free_port() -> int:
     return port
 
 
+def kill_process_group(proc) -> None:
+    """Last-resort sweep of a server's WHOLE process group (the server is
+    spawned with start_new_session=True so pgid == its pid). Idempotent;
+    safe after a normal wait()."""
+    import signal
+
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+
+
 def _accounts_body(start_id: int, count: int) -> bytes:
     arr = np.zeros(count, dtype=ACCOUNT_DTYPE)
     arr["id_lo"] = np.arange(start_id, start_id + count, dtype=np.uint64)
@@ -153,7 +165,8 @@ def run_e2e(
     # prepend (not replace) PYTHONPATH: the TPU runtime may be provided by
     # a site dir already on it
     pp = os.environ.get("PYTHONPATH", "")
-    env = dict(os.environ, PYTHONPATH=f"{REPO}:{pp}" if pp else REPO)
+    env = dict(os.environ, PYTHONPATH=f"{REPO}:{pp}" if pp else REPO,
+               TB_PARENT_WATCHDOG="1")
     if jax_platform:
         env["TB_JAX_PLATFORM"] = jax_platform
     fmt = subprocess.run(
@@ -162,6 +175,11 @@ def run_e2e(
         cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
     )
     assert fmt.returncode == 0, fmt.stderr
+    # Own process group (start_new_session): teardown kills the whole group
+    # so a wedged server (or anything it forked) cannot outlive the bench
+    # and skew later timings. The server also carries a parent-death
+    # watchdog (cli._install_parent_death_watchdog) for the paths where
+    # this harness itself is SIGKILLed.
     proc = subprocess.Popen(
         [sys.executable, "-m", "tigerbeetle_tpu", "start",
          "--addresses", f"127.0.0.1:{port}",
@@ -169,7 +187,7 @@ def run_e2e(
          "--transfer-slots-log2", str(slots_log2),
          "--backend", backend,
          *server_args, path],
-        cwd=REPO, env=env,
+        cwd=REPO, env=env, start_new_session=True,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     try:
@@ -206,10 +224,15 @@ def run_e2e(
         )
         # SIGTERM makes the server emit its [stats] line (group-commit hit
         # rate etc.); after exit the pipe hits EOF, so joining the drain
-        # thread is deterministic (no sleep race).
+        # thread is deterministic (no sleep race). Dual mode drains the
+        # device shadow and compiles+runs the fingerprint kernels at
+        # shutdown — off the clock, but the wait must cover it.
         proc.terminate()
         try:
-            proc.wait(timeout=10)
+            # dual mode: must outlast DualLedger.finalize's own drain
+            # timeout (600s) or a slow-but-legal verification is killed
+            # mid-flight and the [stats] line is lost
+            proc.wait(timeout=650 if "+" in backend else 10)
         except subprocess.TimeoutExpired:
             pass
         drain_thread.join(timeout=5)
@@ -221,6 +244,8 @@ def run_e2e(
                 result["group_commit_hit_rate"] = round(
                     g.get("fused_ops", 0) / total, 4
                 )
+            if "device_shadow" in server_stats:
+                result["device_shadow"] = server_stats["device_shadow"]
         return result
     finally:
         if proc.poll() is None:
@@ -230,6 +255,7 @@ def run_e2e(
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait()
+        kill_process_group(proc)
         if own_tmp:
             tmp.cleanup()
 

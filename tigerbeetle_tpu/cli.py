@@ -53,6 +53,9 @@ class StartArgs:
     # Commit backend: "native" = the C++ host engine (native/ledger.cc —
     # the durable hot path; this environment's tunneled TPU degrades
     # permanently on any device->host fetch, see models/native_ledger.py),
+    # "native+device" = the DUAL mode: native serves replies while the
+    # device shadows every prepare (h2d only) and shutdown verifies the
+    # device state bit-exact (models/dual_ledger.py),
     # "device" = the JAX DeviceLedger (the TPU compute path; supports
     # HBM->LSM spill), "sharded" = the multi-chip ShardedLedger over a
     # jax.sharding.Mesh (parallel/mesh.py; slots flags are PER SHARD).
@@ -96,12 +99,41 @@ def cmd_format(args) -> int:
     return 0
 
 
+def _install_parent_death_watchdog() -> None:
+    """Die with the spawner — OPT-IN via TB_PARENT_WATCHDOG=1 (the bench and
+    test harnesses set it when they spawn `start` as a subprocess). If the
+    harness is SIGKILLed (or a teardown path is skipped) the server used to
+    outlive it and burn CPU on the shared bench machine, skewing every
+    later measurement. PR_SET_PDEATHSIG delivers SIGTERM the moment the
+    parent thread exits; the ppid re-check closes the race where the parent
+    died before the prctl landed. Opt-in because a production/daemonized
+    start (systemd, `... start &` from a wrapper that exits) legitimately
+    outlives its launcher."""
+    import ctypes
+    import os
+    import signal
+
+    if os.environ.get("TB_PARENT_WATCHDOG") != "1":
+        return
+    if not sys.platform.startswith("linux"):
+        return
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGTERM, 0, 0, 0)
+        if os.getppid() == 1:  # parent already gone: orphaned at birth
+            raise SystemExit(0)
+    except (OSError, AttributeError):
+        pass  # non-glibc platform: watchdog unavailable, teardown still kills
+
+
 def cmd_start(args) -> int:
     import faulthandler
     import os
     import signal
 
     faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps stacks
+    _install_parent_death_watchdog()
     debug_boot = bool(os.environ.get("TB_DEBUG"))
 
     def boot(msg: str) -> None:
@@ -139,6 +171,12 @@ def cmd_start(args) -> int:
         backend_factory = lambda: NativeLedger(  # noqa: E731
             args.account_slots_log2, args.transfer_slots_log2
         )
+    elif args.backend == "native+device":
+        from tigerbeetle_tpu.models.dual_ledger import DualLedger
+
+        backend_factory = lambda: DualLedger(  # noqa: E731
+            args.account_slots_log2, args.transfer_slots_log2
+        )
     elif args.backend == "sharded":
         import jax
         import numpy as _np
@@ -161,7 +199,8 @@ def cmd_start(args) -> int:
         )
     elif args.backend != "device":
         flags.fatal(
-            f"unknown --backend {args.backend!r} (native|device|sharded)"
+            f"unknown --backend {args.backend!r} "
+            "(native|native+device|device|sharded)"
         )
     replica = Replica(
         args.replica, len(addresses), storage, bus, RealTime(),
@@ -206,6 +245,20 @@ def cmd_start(args) -> int:
         }
         if getattr(replica.ledger, "spill", None) is not None:
             stats["spill"] = dict(replica.ledger.spill.stats)
+        if hasattr(replica.ledger, "finalize"):
+            # dual mode: drain the device shadow, then the process's FIRST
+            # d2h reads verify the device state bit-exact (after the
+            # harness's clock has already stopped — the timed phase never
+            # paid a device round trip). Never let verification failure
+            # eat the [stats] line itself.
+            try:
+                replica.flush_commits()
+                stats["device_shadow"] = replica.ledger.finalize()
+            except Exception as e:
+                stats["device_shadow"] = {
+                    "verified": False,
+                    "error": f"{type(e).__name__}: {e}",
+                }
         print(f"[stats] {_json.dumps(stats)}", flush=True)
         if prof is not None:
             prof.disable()

@@ -1,0 +1,262 @@
+"""DualLedger: native C++ engine serves replies, the TPU shadows every
+prepare — the `--backend native+device` durable mode.
+
+The problem this solves (round-4 verdict): on this environment's tunneled
+TPU, ANY device->host fetch permanently degrades the dispatch path
+(models/native_ledger.py), so a reply-serving server cannot run its hot
+loop through the device — but that blocks *reply-from-device*, not
+*commit-on-device*. Here the native engine (native/ledger.cc) computes
+reply codes at host speed, while a background shadow thread applies the
+SAME prepares, same timestamps, same order, to the JAX DeviceLedger —
+host->device uploads and kernel launches only, nothing ever read back
+until shutdown. Device state is REAL state: maintained batch-by-batch by
+the same commit kernels the flagship benchmark measures.
+
+Verification (hash_log semantics, testing/hash_log.py):
+- every batch's dense reply codes are folded into a chained u64 digest on
+  BOTH sides — on device (fold_reply_codes, no d2h) and on host over the
+  native engine's codes (fold_reply_codes_np, chained off the engine
+  worker's completion callbacks, same FIFO order);
+- at shutdown, finalize() drains the shadow queue and does the process's
+  FIRST device->host reads: the two fold scalars must match (the full
+  reply-code stream was bit-identical), and state_fingerprint — an
+  order-independent digest over every live account/transfer row's 128-byte
+  wire image, implemented identically in C++ (tb_ledger_fingerprint) and
+  JAX (models/ledger.py state_fingerprint) — must match row-set for
+  row-set.
+
+Reference seam: src/state_machine.zig:508-540 — commit determinism is the
+consensus invariant; the dual mode extends it across heterogeneous engines
+(the reference's simulator cross-checks replicas the same way,
+src/testing/cluster/state_checker.zig).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from tigerbeetle_tpu.constants import ConfigProcess
+from tigerbeetle_tpu.models.native_ledger import NativeLedger
+from tigerbeetle_tpu.types import Operation
+
+_STOP = object()
+
+
+class DualLedger:
+    """Replica backend: NativeLedger semantics + an asynchronous device
+    shadow. All reply-serving calls delegate to the native engine; the
+    device never blocks (or touches) the reply path."""
+
+    zero_copy_events = True  # both consumers only read the event rows
+
+    def __init__(
+        self,
+        acct_slots_log2: int = 16,
+        xfer_slots_log2: int = 20,
+        queue_max: int = 256,
+    ):
+        self.native = NativeLedger(acct_slots_log2, xfer_slots_log2)
+        from tigerbeetle_tpu.models.ledger import DeviceLedger
+
+        self.device = DeviceLedger(
+            process=ConfigProcess(
+                account_slots_log2=acct_slots_log2,
+                transfer_slots_log2=xfer_slots_log2,
+            ),
+            mode="auto",
+        )
+        self.device.prefetch_results = False  # NO d2h until finalize()
+        self.process = None  # replica duck-typing (native backend shape)
+        self.spill = None
+        self.hazards = self.device.hazards  # [stats] observability
+        # chained digests of the dense reply-code stream (hash_log pair)
+        self._chk_native = 0
+        self._chk_lock = threading.Lock()
+        self._shadow_error: Exception | None = None
+        self._shadow_batches = 0
+        self._restored = False  # device cannot follow a snapshot restore
+        self._q: queue.Queue = queue.Queue(maxsize=queue_max)
+        self._thread = threading.Thread(
+            target=self._shadow_loop, name="device-shadow", daemon=True
+        )
+        self._thread.start()
+
+    # -- the device shadow ------------------------------------------------
+
+    def _shadow_loop(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from tigerbeetle_tpu.models.ledger import fold_reply_codes
+
+        fold = jax.jit(fold_reply_codes)
+        chk = jnp.uint64(0)
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                break
+            if self._shadow_error is not None or self._restored:
+                continue  # drain without applying; finalize reports why
+            op, ts, arr = item
+            try:
+                pending = self.device.execute_async(op, ts, arr)
+                chk = fold(chk, pending.results, jnp.int32(len(arr)))
+                self._shadow_batches += 1
+            except Exception as e:  # divergence surfaces at finalize
+                self._shadow_error = e
+        self._chk_device_scalar = chk
+
+    def _enqueue_shadow(self, operation, timestamp: int, arr) -> None:
+        # the queue bounds host-memory growth; a full queue briefly
+        # backpressures the event loop rather than dropping shadow batches
+        # (a dropped batch would be an unverifiable run, not a fast one)
+        self._q.put((operation, timestamp, arr))
+
+    def _fold_native(self, pending) -> None:
+        """Chain the native codes into the host-side digest when the engine
+        worker completes the batch (FIFO worker => stream order matches the
+        shadow queue's)."""
+        from tigerbeetle_tpu.models.ledger import fold_reply_codes_np
+
+        def _cb(_fut, codes=pending.codes):
+            with self._chk_lock:
+                self._chk_native = fold_reply_codes_np(self._chk_native, codes)
+
+        pending.fut.add_done_callback(_cb)
+
+    # -- backend protocol (reply path: native) ----------------------------
+
+    @property
+    def prepare_timestamp(self) -> int:
+        return self.native.prepare_timestamp
+
+    @prepare_timestamp.setter
+    def prepare_timestamp(self, value: int) -> None:
+        self.native.prepare_timestamp = value
+
+    def prepare(self, operation: Operation, event_count: int) -> None:
+        self.native.prepare(operation, event_count)
+
+    def execute_async(self, operation, timestamp: int, events):
+        arr = events if isinstance(events, np.ndarray) else None
+        pending = self.native.execute_async(operation, timestamp, events)
+        if operation in (Operation.create_accounts, Operation.create_transfers):
+            if arr is None:
+                # list-of-objects path (REPL/tests): reuse the bytes the
+                # native wrapper built
+                from tigerbeetle_tpu import types as _t
+
+                arr = (
+                    _t.accounts_to_np(events)
+                    if operation == Operation.create_accounts
+                    else _t.transfers_to_np(events)
+                )
+            self._fold_native(pending)
+            self._enqueue_shadow(operation, timestamp, arr)
+        return pending
+
+    def try_execute_group_async(self, items):
+        pendings = self.native.try_execute_group_async(items)
+        if pendings is None:
+            return None
+        for (ts, arr), p in zip(items, pendings):
+            self._fold_native(p)
+            self._enqueue_shadow(Operation.create_transfers, ts, arr)
+        return pendings
+
+    def drain(self, pending):
+        return self.native.drain(pending)
+
+    def drain_many(self, pendings) -> None:
+        self.native.drain_many(pendings)
+
+    def drain_reply(self, pending, operation) -> bytes:
+        return self.native.drain_reply(pending, operation)
+
+    def execute_dense(self, operation, timestamp: int, events):
+        return self.drain(self.execute_async(operation, timestamp, events))
+
+    def execute(self, operation, timestamp: int, events):
+        dense = self.execute_dense(operation, timestamp, events)
+        return [(i, c) for i, c in enumerate(dense) if c]
+
+    def lookup_rows(self, operation: Operation, ids) -> bytes:
+        return self.native.lookup_rows(operation, ids)
+
+    def lookup_accounts(self, ids):
+        return self.native.lookup_accounts(ids)
+
+    def lookup_transfers(self, ids):
+        return self.native.lookup_transfers(ids)
+
+    def counts(self) -> dict:
+        return self.native.counts()
+
+    @property
+    def commit_timestamp(self) -> int:
+        return self.native.commit_timestamp
+
+    def snapshot_bytes(self) -> bytes:
+        return self.native.snapshot_bytes()
+
+    def restore_bytes(self, raw: bytes) -> None:
+        self.native.restore_bytes(raw)
+        # The device table cannot be rebuilt from a mid-history snapshot
+        # without a row-level upload path; the shadow stands down and
+        # finalize() reports it (bench/format-fresh runs never hit this).
+        if len(raw) > 64 and self.native.counts()["accounts"] > 0:
+            self._restored = True
+
+    # -- shutdown verification --------------------------------------------
+
+    def finalize(self, timeout: float = 600.0) -> dict:
+        """Drain the shadow, then do the process's FIRST d2h reads: compare
+        the two reply-code digests and the two state fingerprints. Returns
+        the verification report the server prints on its [stats] line."""
+        self._q.put(_STOP)
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            return {"verified": False, "error": "shadow drain timed out"}
+        if self._restored:
+            return {
+                "verified": None,
+                "skipped": "snapshot restore: shadow stood down",
+            }
+        if self._shadow_error is not None:
+            return {
+                "verified": False,
+                "error": f"{type(self._shadow_error).__name__}: "
+                f"{self._shadow_error}",
+            }
+        try:
+            self.device.check_fault()  # deferred fault word: report, not
+        except Exception as e:         # crash — the [stats] line must land
+            return {
+                "verified": False,
+                "error": f"{type(e).__name__}: {e}",
+            }
+        chk_dev = int(np.asarray(self._chk_device_scalar))
+        # the native fold chain is complete once the engine worker idles
+        self.native.drain_many([])  # no-op; engine queue is FIFO
+        with self._chk_lock:
+            chk_nat = self._chk_native
+        fp_nat = self.native.fingerprint()
+        fp_dev = self.device.fingerprint()
+        ok = (
+            chk_nat == chk_dev
+            and fp_nat["accounts_fp"] == fp_dev["accounts_fp"]
+            and fp_nat["transfers_fp"] == fp_dev["transfers_fp"]
+            and fp_nat["accounts"] == fp_dev["accounts"]
+            and fp_nat["transfers"] == fp_dev["transfers"]
+            and fp_nat["commit_timestamp"] == fp_dev["commit_timestamp"]
+        )
+        return {
+            "verified": bool(ok),
+            "shadow_batches": self._shadow_batches,
+            "code_stream_digest": {"native": chk_nat, "device": chk_dev},
+            "fingerprint_native": fp_nat,
+            "fingerprint_device": fp_dev,
+        }

@@ -140,6 +140,99 @@ def raise_on_fault(fault: int, what: str) -> None:
 
 
 # ----------------------------------------------------------------------
+# state fingerprint + reply-code fold (the dual-commit parity seam)
+#
+# Order-independent digest over LIVE table rows: sum (mod 2^64) of a
+# per-row hash of the 128-byte wire image. The native C++ engine implements
+# the IDENTICAL function over its host tables (native/ledger.cc
+# tb_ledger_fingerprint), so two parity-locked engines that processed the
+# same prepares agree iff their logical row sets are bit-identical —
+# regardless of slot layout (device open-addressing vs host table). Any
+# constant below changes BOTH implementations or dual-commit verification
+# breaks loudly.
+# ----------------------------------------------------------------------
+
+_FP_SEED = np.uint64(0x9E3779B97F4A7C15)
+_FP_MUL = np.uint64(0xC2B2AE3D27D4EB4F)
+_FP_ADD = np.uint64(0x165667B19E3779F9)
+_FP_MIX1 = np.uint64(0xFF51AFD7ED558CCD)
+_FP_MIX2 = np.uint64(0xC4CEB9FE1A85EC53)
+
+
+def _fp_mix(x):
+    x = (x ^ (x >> jnp.uint64(33))) * _FP_MIX1
+    x = (x ^ (x >> jnp.uint64(33))) * _FP_MIX2
+    return x ^ (x >> jnp.uint64(33))
+
+
+def _fp_rows(rows):
+    """[S, 32]-u32 table -> (u64 fp sum over live rows, u64 live count).
+    Empty (key words all-0) and tombstone (all-0xFFFFFFFF) slots excluded,
+    matching the native table's st[] == full predicate."""
+    h = jnp.full(rows.shape[0], _FP_SEED, dtype=U64)
+    for i in range(ROW_WORDS):
+        h = h ^ (rows[:, i].astype(U64) * _FP_MUL)
+        h = ((h << jnp.uint64(27)) | (h >> jnp.uint64(37))) * _FP_SEED + _FP_ADD
+    h = _fp_mix(h)
+    k4 = rows[:, :4]
+    empty = (k4 == 0).all(axis=1)
+    tomb = (k4 == 0xFFFFFFFF).all(axis=1)
+    live = ~empty & ~tomb
+    return (
+        jnp.sum(jnp.where(live, h, jnp.uint64(0))),
+        jnp.sum(live.astype(U64)),
+    )
+
+
+def state_fingerprint(state) -> dict:
+    """Jittable digest of the device ledger (dual-commit verification).
+    The trailing dump row (masked-scatter target, never read) is excluded —
+    it holds garbage by design."""
+    afp, alive = _fp_rows(state["acct_rows"][:-1])
+    tfp, tlive = _fp_rows(state["xfer_rows"][:-1])
+    return {
+        "accounts_fp": afp,
+        "transfers_fp": tfp,
+        "accounts": alive,
+        "transfers": tlive,
+        "commit_timestamp": state["commit_ts"],
+    }
+
+
+def fold_reply_codes(chk, results, n):
+    """Jittable running digest of the dense reply-code stream (the
+    hash_log-style shadow check: the dual server folds every shadow batch's
+    codes on DEVICE — no d2h — and compares one scalar at shutdown against
+    the native engine's host-side fold). `results` is the packed
+    [codes(n_pad), fault] vector from execute_async; lanes >= n are
+    padding and excluded. Chained: order of batches is captured."""
+    lane = jnp.arange(results.shape[0], dtype=jnp.int32)
+    m = _fp_mix(
+        results.astype(U64) * _FP_MUL
+        + lane.astype(U64)
+        + jnp.uint64(1)
+    )
+    batch_h = jnp.sum(jnp.where(lane < n, m, jnp.uint64(0)))
+    return _fp_mix(chk ^ (batch_h + jnp.uint64(n).astype(U64)))
+
+
+def fold_reply_codes_np(chk: int, codes: np.ndarray) -> int:
+    """The numpy twin of fold_reply_codes for the native engine's dense
+    codes (exact u64 wraparound semantics)."""
+    with np.errstate(over="ignore"):
+        def mix(x):
+            x = (x ^ (x >> np.uint64(33))) * _FP_MIX1
+            x = (x ^ (x >> np.uint64(33))) * _FP_MIX2
+            return x ^ (x >> np.uint64(33))
+
+        lane = np.arange(len(codes), dtype=np.uint64)
+        m = mix(codes.astype(np.uint64) * _FP_MUL + lane + np.uint64(1))
+        batch_h = np.sum(m, dtype=np.uint64)
+        out = mix(np.uint64(chk) ^ (batch_h + np.uint64(len(codes))))
+        return int(out)
+
+
+# ----------------------------------------------------------------------
 # wire-row pack/unpack (word offsets = byte offsets / 4 of the extern
 # structs, reference: src/tigerbeetle.zig:7-40 Account, :64-89 Transfer)
 # ----------------------------------------------------------------------
@@ -1897,6 +1990,15 @@ class DeviceLedger(HostLedgerBase):
             )
             for i, (_ts, arr) in enumerate(items)
         ]
+
+    def fingerprint(self) -> dict:
+        """Materialized state_fingerprint (ONE scalar-only d2h — the dual
+        server calls this once, after its clock stops)."""
+        fn = getattr(self, "_fingerprint_cache", None)
+        if fn is None:
+            fn = self._fingerprint_cache = jax.jit(state_fingerprint)
+        out = fn(self.state)
+        return {k: int(np.asarray(v)) for k, v in out.items()}
 
     def check_fault(self) -> None:
         """Raise if the device hit the fault protocol (see module docstring).

@@ -129,6 +129,75 @@ class NativeLedger:
         )
         return _NativePending(operation, n, codes, fut, arr if arr is not None else raw)
 
+    GROUP_MAX = 16  # fused prepares per worker call (mirrors Replica.GROUP_MAX)
+
+    def try_execute_group_async(self, items) -> list[_NativePending] | None:
+        """Fused commit: a run of quorum-ready create_transfers prepares
+        executed by ONE worker-queue call (one GIL release + one FIFO hop
+        instead of k), preserving exact per-batch semantics — each batch
+        keeps its own timestamp and dense codes. `items` =
+        [(timestamp, transfer_rows_ndarray), ...]. The group seam the
+        device backend exposes for kernel fusion serves here to amortize
+        the per-submit overhead of the host engine (reference pipelining:
+        src/vsr/replica.zig:3263-3315)."""
+        k = len(items)
+        if k < 2:
+            return None
+        items = items[: self.GROUP_MAX]
+        k = len(items)
+        arrs = [np.ascontiguousarray(a) for _, a in items]
+        codes = [np.empty(len(a), dtype=np.uint32) for a in arrs]
+        fails = np.full(k, -1, dtype=np.int64)
+        ns = (ctypes.c_uint32 * k)(*[len(a) for a in arrs])
+        tss = (ctypes.c_uint64 * k)(*[int(ts) for ts, _ in items])
+        ptrs = (ctypes.c_void_p * k)(*[a.ctypes.data for a in arrs])
+        outs = (ctypes.c_void_p * k)(*[c.ctypes.data for c in codes])
+        keepalive = (arrs, codes, fails, ns, tss, ptrs, outs)
+
+        def _run():
+            rc = self._lib.tb_ledger_execute_group(
+                self._h, int(Operation.create_transfers), ptrs, ns, tss, k,
+                outs, fails.ctypes.data_as(ctypes.c_void_p),
+            )
+            assert rc == 0, "tb_ledger_execute_group: invalid arguments"
+            return keepalive
+
+        gfut = self._submit(_run)
+        pendings = []
+        for j in range(k):
+            f: Future = Future()
+
+            def _chain(gf, j=j, f=f):
+                if gf.exception() is not None:
+                    f.set_exception(gf.exception())
+                else:
+                    f.set_result(int(fails[j]))
+
+            gfut.add_done_callback(_chain)
+            pendings.append(_NativePending(
+                Operation.create_transfers, len(arrs[j]), codes[j], f, arrs[j]
+            ))
+        return pendings
+
+    def fingerprint(self) -> dict:
+        """Order-independent digest of the live table contents (rides the
+        worker queue: sees every prior commit). Matches the DeviceLedger's
+        state_fingerprint iff the logical row sets are bit-identical — the
+        dual-commit verification seam."""
+        out = np.zeros(8, dtype=np.uint64)
+        self._submit(
+            self._lib.tb_ledger_fingerprint,
+            self._h, out.ctypes.data_as(ctypes.c_void_p),
+        ).result()
+        return {
+            "accounts_fp": int(out[0]),
+            "transfers_fp": int(out[1]),
+            "accounts": int(out[2]),
+            "transfers": int(out[3]),
+            "posted": int(out[4]),
+            "commit_timestamp": int(out[5]),
+        }
+
     def drain(self, pending: _NativePending) -> list[int]:
         pending.wait()
         if pending.dense is None:

@@ -69,6 +69,16 @@ def lib() -> ctypes.CDLL:
                 ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p,
             ]
             l.tb_ledger_execute.restype = ctypes.c_int64
+            l.tb_ledger_execute_group.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint8, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+                ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            l.tb_ledger_execute_group.restype = ctypes.c_int64
+            l.tb_ledger_fingerprint.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p
+            ]
+            l.tb_ledger_fingerprint.restype = None
             l.tb_ledger_lookup.argtypes = [
                 ctypes.c_void_p, ctypes.c_uint8, ctypes.c_char_p,
                 ctypes.c_uint32, ctypes.c_void_p,
