@@ -465,11 +465,15 @@ def bench_e2e(stage) -> dict:
     n = int(os.environ.get("BENCH_E2E_TRANSFERS", 2_000_000))
     n_runs = int(os.environ.get("BENCH_E2E_RUNS", 3))
     clients = int(os.environ.get("BENCH_E2E_CLIENTS", 10))
+    # ONE client process drives the whole protocol through the async packet
+    # ABI (native/tb_client.cc session pool) — BENCH_E2E_DRIVER=python
+    # falls back to the per-session Python driver
+    driver = os.environ.get("BENCH_E2E_DRIVER", "async")
     try:
         out = _median_e2e(
             stage, "e2e_durable", n_runs, log,
             n_accounts=N_ACCOUNTS, n_transfers=n, clients=clients,
-            backend="native+device",
+            backend="native+device", driver=driver,
         )
     except Exception as e:  # never sink the kernel benchmark
         print(f"[e2e] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
@@ -480,11 +484,16 @@ def bench_e2e(stage) -> dict:
             n_accounts=N_ACCOUNTS,
             n_transfers=int(os.environ.get("BENCH_E2E_TP", 1_000_000)),
             clients=clients, workload="two_phase", backend="native+device",
+            driver=driver,
         )
         out["two_phase"] = tp
         out["durable_two_phase_tps"] = tp["durable_tps"]
         out["durable_two_phase_runs"] = tp["durable_runs"]
         out["durable_two_phase_spread"] = tp["durable_spread"]
+        # the headline verified flag covers EVERY dual run, both workloads
+        out["shadow_verified_all"] = bool(
+            out.get("shadow_verified_all")
+        ) and bool(tp.get("shadow_verified_all"))
     except Exception as e:
         out["two_phase"] = {"error": f"{type(e).__name__}: {e}"}
         print(f"[e2e two-phase] FAILED: {e}", file=sys.stderr)

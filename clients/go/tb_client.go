@@ -27,6 +27,7 @@ import (
 	"encoding/binary"
 	"errors"
 	"fmt"
+	"sync"
 	"unsafe"
 )
 
@@ -187,4 +188,116 @@ func structBytes(p unsafe.Pointer) []byte {
 
 func structSlice(p unsafe.Pointer) []byte {
 	return unsafe.Slice((*byte)(p), eventSize)
+}
+
+// -- async packet API (the reference's packet/completion model) ----------
+//
+// AsyncClient owns a pool of sessions; Submit* return a channel that
+// yields the result when its request completes. Go's equivalent of the C
+// tb_client_async session pool (native/tb_client.h): goroutines multiplex
+// a shared work queue over N blocking sessions — the idiomatic Go shape
+// for N-in-flight, no cgo callback trampoline needed.
+
+// AsyncResult carries one completed packet.
+type AsyncResult struct {
+	Reply []byte
+	Err   error
+}
+
+type asyncWork struct {
+	op       uint8
+	body     []byte
+	replyCap int
+	done     chan AsyncResult
+}
+
+// AsyncClient is a pool of sessions driving a shared packet queue.
+type AsyncClient struct {
+	sessions []*Client
+	work     chan asyncWork
+	stop     chan struct{}
+	wg       sync.WaitGroup
+}
+
+// NewAsyncClient registers `sessions` sessions and starts their workers.
+func NewAsyncClient(addresses string, cluster uint32, sessions int) (*AsyncClient, error) {
+	if sessions < 1 {
+		sessions = 1
+	}
+	a := &AsyncClient{
+		work: make(chan asyncWork, sessions*4),
+		stop: make(chan struct{}),
+	}
+	for i := 0; i < sessions; i++ {
+		c, err := NewClient(addresses, cluster)
+		if err != nil {
+			a.Close()
+			return nil, err
+		}
+		a.sessions = append(a.sessions, c)
+		a.wg.Add(1)
+		go func(c *Client) {
+			defer a.wg.Done()
+			for {
+				select {
+				case w := <-a.work:
+					reply, err := c.request(w.op, w.body, w.replyCap)
+					w.done <- AsyncResult{Reply: reply, Err: err}
+				case <-a.stop:
+					return
+				}
+			}
+		}(c)
+	}
+	return a, nil
+}
+
+func (a *AsyncClient) submit(op uint8, body []byte, replyCap int) chan AsyncResult {
+	done := make(chan AsyncResult, 1)
+	select {
+	case a.work <- asyncWork{op: op, body: body, replyCap: replyCap, done: done}:
+	case <-a.stop:
+		done <- AsyncResult{Err: errors.New("async client closed")}
+	}
+	return done
+}
+
+// SubmitCreateTransfers enqueues a batch; receive from the returned channel
+// for its sparse results.
+func (a *AsyncClient) SubmitCreateTransfers(transfers []Transfer) chan AsyncResult {
+	body := make([]byte, 0, len(transfers)*eventSize)
+	for i := range transfers {
+		body = append(body, structBytes(unsafe.Pointer(&transfers[i]))...)
+	}
+	return a.submit(opCreateTransfers, body, len(transfers)*resultSize)
+}
+
+// SubmitCreateAccounts enqueues a batch of account creates.
+func (a *AsyncClient) SubmitCreateAccounts(accounts []Account) chan AsyncResult {
+	body := make([]byte, 0, len(accounts)*eventSize)
+	for i := range accounts {
+		body = append(body, structBytes(unsafe.Pointer(&accounts[i]))...)
+	}
+	return a.submit(opCreateAccounts, body, len(accounts)*resultSize)
+}
+
+// Close stops the workers, waits for in-flight requests to complete, fails
+// any queued-but-unstarted work, then deinits every session (never while a
+// worker is still inside the native library).
+func (a *AsyncClient) Close() {
+	close(a.stop)
+	a.wg.Wait()
+	for {
+		select {
+		case w := <-a.work:
+			w.done <- AsyncResult{Err: errors.New("async client closed")}
+			continue
+		default:
+		}
+		break
+	}
+	for _, c := range a.sessions {
+		c.Close()
+	}
+	a.sessions = nil
 }

@@ -249,8 +249,136 @@ class Client {
   }
 }
 
+// -- async packet API (the reference's packet/completion model) ----------
+//
+// A pool of N sessions; submits resolve as Promises. Node's equivalent of
+// the C tb_client_async session pool (native/tb_client.h): with koffi the
+// blocking tb_client_request is dispatched on libuv worker threads via
+// `.async`, so N requests ride the wire concurrently while the event loop
+// stays free — the same in-flight depth the C pool's pthreads provide.
+// (The C-level tb_client_async_* interface with its completion callback is
+// exercised everywhere by tests/test_async_client.py via ctypes.)
+
+class AsyncClient {
+  constructor(addresses, cluster, sessions = 4, libPath) {
+    this._sessions = [];
+    this._free = [];
+    this._waiters = [];
+    for (let i = 0; i < sessions; i++) {
+      const c = new Client(addresses, cluster, libPath);
+      if (c._native.kind !== "koffi") {
+        // ffi-napi also exposes .async on bound functions; normalize
+        c._requestAsync = (op, body, cap) =>
+          new Promise((resolve, reject) => {
+            const reply = Buffer.alloc(cap);
+            const lenPtr = c._native.ref.alloc("uint64");
+            c._native.lib.tb_client_request.async(
+              c._handle, op, body, body.length, reply, cap, lenPtr,
+              (err, rc) => {
+                if (err || rc !== 0) reject(err || new Error(`errno ${-rc}`));
+                else resolve(reply.subarray(0, Number(lenPtr.deref())));
+              }
+            );
+          });
+      } else {
+        c._requestAsync = (op, body, cap) =>
+          new Promise((resolve, reject) => {
+            const reply = Buffer.alloc(cap);
+            const lenOut = [0n];
+            c._native.request.async(
+              c._handle, op, body, BigInt(body.length), reply,
+              BigInt(cap), lenOut,
+              (err, rc) => {
+                if (err || rc !== 0) reject(err || new Error(`errno ${-rc}`));
+                else resolve(reply.subarray(0, Number(lenOut[0])));
+              }
+            );
+          });
+      }
+      this._sessions.push(c);
+      this._free.push(c);
+    }
+  }
+
+  async _withSession(fn) {
+    if (this._closing) throw new Error("async client closed");
+    const c = this._free.length
+      ? this._free.pop()
+      : await new Promise((resolve, reject) =>
+          this._waiters.push({ resolve, reject })
+        );
+    try {
+      return await fn(c);
+    } finally {
+      const w = this._waiters.shift();
+      if (w) w.resolve(c);
+      else {
+        this._free.push(c);
+        if (this._closing && this._onIdle &&
+            this._free.length === this._sessions.length)
+          this._onIdle();
+      }
+    }
+  }
+
+  createAccounts(accounts) {
+    const body = Buffer.concat(accounts.map(packAccount));
+    return this._withSession((c) =>
+      c._requestAsync(OP_CREATE_ACCOUNTS, body, accounts.length * RESULT_SIZE)
+    ).then(unpackResults);
+  }
+
+  createTransfers(transfers) {
+    const body = Buffer.concat(transfers.map(packTransfer));
+    return this._withSession((c) =>
+      c._requestAsync(OP_CREATE_TRANSFERS, body, transfers.length * RESULT_SIZE)
+    ).then(unpackResults);
+  }
+
+  lookupAccounts(ids) {
+    const body = Buffer.alloc(ids.length * ID_SIZE);
+    ids.forEach((x, i) => writeU128(body, i * ID_SIZE, x));
+    return this._withSession((c) =>
+      c._requestAsync(OP_LOOKUP_ACCOUNTS, body, ids.length * EVENT_SIZE)
+    ).then((reply) => {
+      const out = [];
+      for (let off = 0; off + EVENT_SIZE <= reply.length; off += EVENT_SIZE)
+        out.push(unpackAccount(reply, off));
+      return out;
+    });
+  }
+
+  lookupTransfers(ids) {
+    const body = Buffer.alloc(ids.length * ID_SIZE);
+    ids.forEach((x, i) => writeU128(body, i * ID_SIZE, x));
+    return this._withSession((c) =>
+      c._requestAsync(OP_LOOKUP_TRANSFERS, body, ids.length * EVENT_SIZE)
+    ).then((reply) => {
+      const out = [];
+      for (let off = 0; off + EVENT_SIZE <= reply.length; off += EVENT_SIZE)
+        out.push(unpackTransfer(reply, off));
+      return out;
+    });
+  }
+
+  // Waits for in-flight requests to finish (a deinit while a libuv worker
+  // is inside tb_client_request would be a use-after-free), rejects parked
+  // waiters, then deinits every session.
+  async close() {
+    this._closing = true;
+    for (const w of this._waiters.splice(0))
+      w.reject(new Error("async client closed"));
+    if (this._free.length !== this._sessions.length)
+      await new Promise((resolve) => (this._onIdle = resolve));
+    for (const c of this._sessions) c.close();
+    this._sessions = [];
+    this._free = [];
+  }
+}
+
 module.exports = {
   Client,
+  AsyncClient,
   packAccount,
   packTransfer,
   unpackAccount,

@@ -9,6 +9,8 @@
 // time with monotonically increasing request numbers — the reference
 // client's session discipline (reference: src/vsr/client.zig:17-80).
 
+#include "tb_client.h"
+
 #include <arpa/inet.h>
 #include <cerrno>
 #include <cstdint>
@@ -299,6 +301,244 @@ void tb_client_deinit(tb_client *c) {
   if (!c) return;
   if (c->fd >= 0) close(c->fd);
   free(c);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Async packet client (the reference's packet/completion model, re-designed:
+// src/clients/c/tb_client/packet.zig + thread.zig submit packets to a
+// dedicated IO thread and get a completion callback). Here the context owns
+// a POOL of sessions, each with its own blocking worker thread pulling from
+// one shared packet FIFO — N requests genuinely in flight against the
+// replica's commit window from ONE process, which is what the reference's
+// single-session client gets from server-side pipelining. Same-operation
+// create packets that fit one message are COALESCED into a single request
+// and their sparse results demuxed back per packet (the reference's packet
+// batching); lookups ride one packet per request (reply rows skip missing
+// ids, so attribution needs the request body — not worth the ambiguity).
+// ---------------------------------------------------------------------------
+
+#include <pthread.h>
+
+// tb_packet_t / tb_completion_t / the tb_client_async_* prototypes come
+// from tb_client.h (included above) — the ONE definition the Go cgo and
+// ctypes bindings also compile against, so layout drift is a compile
+// error, not silent packet corruption.
+
+extern "C" {
+
+struct tb_async {
+  tb_client *sessions[32];
+  pthread_t threads[32];
+  struct tb_async_worker_arg {
+    struct tb_async *a;
+    uint32_t idx;
+  } worker_args[32];
+  uint32_t session_count;
+  tb_completion_t on_completion;
+  void *ctx;
+  // shared packet FIFO
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+  tb_packet_t *head, *tail;
+  bool shutdown;
+};
+
+}  // extern "C"
+
+namespace {
+
+constexpr uint64_t BODY_MAX = MESSAGE_SIZE_MAX - HEADER_SIZE;
+
+// Pop a run of coalescable packets (caller holds the lock): the head
+// packet, plus — for create ops — following packets of the SAME operation
+// while the combined body fits one message.
+tb_packet_t *pop_run(tb_async *a, uint32_t *run_len, uint64_t *body_len) {
+  tb_packet_t *first = a->head;
+  if (!first) return nullptr;
+  uint32_t n = 1;
+  uint64_t bytes = first->data_size;
+  tb_packet_t *last = first;
+  if (first->operation == 128 || first->operation == 129) {
+    while (last->next && last->next->operation == first->operation &&
+           bytes + last->next->data_size <= BODY_MAX) {
+      last = last->next;
+      bytes += last->data_size;
+      n++;
+    }
+  }
+  a->head = last->next;
+  if (!a->head) a->tail = nullptr;
+  last->next = nullptr;
+  *run_len = n;
+  *body_len = bytes;
+  return first;
+}
+
+void complete_run(tb_async *a, tb_packet_t *run, int rc) {
+  while (run) {
+    tb_packet_t *next = run->next;
+    run->next = nullptr;
+    run->status = rc;
+    a->on_completion(a->ctx, run, nullptr, 0);
+    run = next;
+  }
+}
+
+void *async_worker(void *arg_) {
+  auto *arg = (tb_async::tb_async_worker_arg *)arg_;
+  tb_async *a = arg->a;
+  tb_client *c = a->sessions[arg->idx];
+  auto *body = (uint8_t *)malloc(BODY_MAX);
+  auto *reply = (uint8_t *)malloc(BODY_MAX);
+  for (;;) {
+    pthread_mutex_lock(&a->mu);
+    while (!a->head && !a->shutdown) pthread_cond_wait(&a->cv, &a->mu);
+    uint32_t run_len = 0;
+    uint64_t body_len = 0;
+    tb_packet_t *run = pop_run(a, &run_len, &body_len);
+    pthread_mutex_unlock(&a->mu);
+    if (!run) break;  // shutdown + drained
+    if (!body || !reply) {
+      complete_run(a, run, -ENOMEM);
+      continue;
+    }
+    // coalesce bodies
+    uint64_t off = 0;
+    for (tb_packet_t *p = run; p; p = p->next) {
+      memcpy(body + off, p->data, p->data_size);
+      off += p->data_size;
+    }
+    uint64_t reply_len = 0;
+    c->request_number += 1;
+    int rc = submit_rotating(c, run->operation, c->request_number, body,
+                             body_len, reply, BODY_MAX, &reply_len);
+    if (rc != 0) {
+      complete_run(a, run, rc);
+      continue;
+    }
+    if (run_len == 1) {
+      run->status = 0;
+      a->on_completion(a->ctx, run, reply, reply_len);
+      continue;
+    }
+    // Demux coalesced create results: sparse {u32 index, u32 result}
+    // entries ordered by index; each packet consumes the entries whose
+    // index falls in its event range, rebased in place.
+    uint64_t entry = 0, entries = reply_len / 8;
+    uint32_t ev_off = 0;
+    for (tb_packet_t *p = run; p;) {
+      tb_packet_t *next = p->next;
+      uint32_t ev_count = p->data_size / 128;
+      uint64_t start = entry;
+      while (entry < entries) {
+        uint32_t eidx;
+        memcpy(&eidx, reply + entry * 8, 4);
+        if (eidx >= ev_off + ev_count) break;
+        eidx -= ev_off;
+        memcpy(reply + entry * 8, &eidx, 4);
+        entry++;
+      }
+      p->next = nullptr;
+      p->status = 0;
+      a->on_completion(a->ctx, p, reply + start * 8, (entry - start) * 8);
+      ev_off += ev_count;
+      p = next;
+    }
+  }
+  free(body);
+  free(reply);
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+/* Session pool + completion callback. client_id_base: 16 bytes, nonzero;
+ * session i perturbs byte 0 by +i (ids must stay unique cluster-wide).
+ * sessions: 1..32 concurrent sessions (each one VSR session = one request
+ * in flight; the pool is the process's in-flight depth). The callback runs
+ * on worker threads — it must be thread-safe. Returns 0 or -errno. */
+int tb_client_async_init(tb_async **out, const char *addresses,
+                         uint32_t cluster, const uint8_t client_id_base[16],
+                         uint32_t sessions, tb_completion_t on_completion,
+                         void *ctx) {
+  if (sessions < 1 || sessions > 32 || !on_completion) return -EINVAL;
+  auto *a = (tb_async *)calloc(1, sizeof(tb_async));
+  if (!a) return -ENOMEM;
+  a->session_count = sessions;
+  a->on_completion = on_completion;
+  a->ctx = ctx;
+  pthread_mutex_init(&a->mu, nullptr);
+  pthread_cond_init(&a->cv, nullptr);
+  for (uint32_t i = 0; i < sessions; i++) {
+    uint8_t cid[16];
+    memcpy(cid, client_id_base, 16);
+    cid[0] = (uint8_t)(cid[0] + i);
+    int rc = tb_client_init(&a->sessions[i], addresses, 0, cluster, cid);
+    if (rc != 0) {
+      for (uint32_t j = 0; j < i; j++) tb_client_deinit(a->sessions[j]);
+      free(a);
+      return rc;
+    }
+  }
+  for (uint32_t i = 0; i < sessions; i++) {
+    a->worker_args[i] = {a, i};
+    if (pthread_create(&a->threads[i], nullptr, async_worker,
+                       &a->worker_args[i]) != 0) {
+      pthread_mutex_lock(&a->mu);
+      a->shutdown = true;
+      pthread_cond_broadcast(&a->cv);
+      pthread_mutex_unlock(&a->mu);
+      for (uint32_t j = 0; j < i; j++) pthread_join(a->threads[j], nullptr);
+      for (uint32_t j = 0; j < sessions; j++)
+        tb_client_deinit(a->sessions[j]);
+      free(a);
+      return -EAGAIN;
+    }
+  }
+  *out = a;
+  return 0;
+}
+
+/* Submit a packet (caller keeps ownership of packet + data until its
+ * completion callback fires). Packets are picked up FIFO by the session
+ * pool; same-operation create packets may be coalesced into one request. */
+int tb_client_async_submit(tb_async *a, tb_packet_t *p) {
+  if (!a || !p || !p->data || p->data_size == 0 ||
+      p->data_size > BODY_MAX)
+    return -EINVAL;
+  p->next = nullptr;
+  p->status = 1; /* in flight */
+  pthread_mutex_lock(&a->mu);
+  if (a->shutdown) {
+    pthread_mutex_unlock(&a->mu);
+    return -ESHUTDOWN;
+  }
+  if (a->tail) a->tail->next = p;
+  else a->head = p;
+  a->tail = p;
+  pthread_cond_signal(&a->cv);
+  pthread_mutex_unlock(&a->mu);
+  return 0;
+}
+
+/* Drain: workers finish every queued packet, then exit. */
+void tb_client_async_deinit(tb_async *a) {
+  if (!a) return;
+  pthread_mutex_lock(&a->mu);
+  a->shutdown = true;
+  pthread_cond_broadcast(&a->cv);
+  pthread_mutex_unlock(&a->mu);
+  for (uint32_t i = 0; i < a->session_count; i++)
+    pthread_join(a->threads[i], nullptr);
+  for (uint32_t i = 0; i < a->session_count; i++)
+    tb_client_deinit(a->sessions[i]);
+  pthread_mutex_destroy(&a->mu);
+  pthread_cond_destroy(&a->cv);
+  free(a);
 }
 
 }  // extern "C"

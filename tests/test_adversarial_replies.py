@@ -1,0 +1,208 @@
+"""Adversarial-reply pressure on the client side (reference:
+src/vsr/client.zig:17-80 — the session client checksums every reply and
+matches request numbers, so a Byzantine/stale/corrupt frame can never be
+surfaced to the application).
+
+A fake raw-socket "replica" feeds each client a corrupted-header reply, a
+corrupted-body reply, a stale-request-number reply, and a truncated frame,
+then the genuine reply — both the Python vsr client and the native C
+client must surface ONLY the genuine one."""
+
+import os
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
+
+
+def _reply(request: int, body: bytes, operation: int,
+            corrupt_header: bool = False, corrupt_body: bool = False,
+            view: int = 0) -> bytes:
+    h = Header(
+        command=int(Command.reply),
+        operation=operation,
+        request=request,
+        view=view,
+    )
+    h.set_checksum_body(body)
+    h.set_checksum()
+    wire = bytearray(h.to_bytes() + body)
+    if corrupt_header:
+        wire[8] ^= 0xFF  # flips the header checksum field itself
+    if corrupt_body and body:
+        wire[HEADER_SIZE] ^= 0xFF  # body no longer matches checksum_body
+    return bytes(wire)
+
+
+class _FakeReplica:
+    """Accepts one client connection and replays a scripted reply sequence
+    for each request that arrives."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self.script = []  # per-request: callable(request_header) -> [bytes]
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.errors: list[Exception] = []
+
+    def _read_exact(self, conn, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            got = conn.recv(n - len(buf))
+            if not got:
+                raise ConnectionError("client closed")
+            buf += got
+        return buf
+
+    def _run(self):
+        try:
+            conn, _ = self.sock.accept()
+            conn.settimeout(30)
+            steps = list(self.script)
+            while steps:
+                raw = self._read_exact(conn, HEADER_SIZE)
+                h = Header.from_bytes(raw)
+                body = self._read_exact(conn, h.size - HEADER_SIZE)
+                if h.command != Command.request:
+                    continue  # bus hello frames etc.: not a request
+                step = steps.pop(0)
+                for wire in step(h, body):
+                    conn.sendall(wire)
+            conn.close()
+        except Exception as e:  # surfaced by the test at join
+            self.errors.append(e)
+
+    def start(self):
+        self.thread.start()
+
+    def join(self):
+        self.thread.join(timeout=30)
+        self.sock.close()
+        assert not self.errors, self.errors
+
+
+def _scripted_session(adversarial_for_request_1):
+    """Script: register succeeds cleanly; request 1 gets the adversarial
+    barrage then the genuine reply."""
+    session = 7
+
+    def on_register(h, _body):
+        return [_reply(0, session.to_bytes(8, "little"),
+                       int(Operation.register))]
+
+    def on_request(h, _body):
+        return adversarial_for_request_1(h)
+
+    return [on_register, on_request]
+
+
+def _barrage(h):
+    """Corrupt header, corrupt body, stale request number, truncated
+    frame... then the genuine empty-body success reply."""
+    genuine = _reply(h.request, b"", h.operation)
+    stale = _reply(h.request - 1, b"\x01\x02\x03\x04\x05\x06\x07\x08",
+                   h.operation)
+    corrupt_h = _reply(h.request, b"", h.operation, corrupt_header=True)
+    corrupt_b = _reply(h.request, b"\x00" * 8, h.operation,
+                       corrupt_body=True)
+    # Truncated FRAME: a header announcing 128+8 bytes but only 4 bytes of
+    # body before the genuine reply follows — the stream recovers only if
+    # the client's framing treats the checksum gate as authoritative.
+    # (For stream transports a truncated frame shifts framing; both
+    # clients recover because every candidate frame is checksum-gated.)
+    trunc_h = Header(
+        command=int(Command.reply), operation=h.operation, request=h.request
+    )
+    trunc_h.set_checksum_body(b"\xEE" * 8)
+    trunc_h.set_checksum()
+    truncated = trunc_h.to_bytes() + b"\xEE" * 4  # 4 bytes short
+    pad = b"\x00" * 4  # realign the stream for the genuine frame
+    return [corrupt_h, corrupt_b, stale, truncated + pad, genuine]
+
+
+def test_python_client_rejects_adversarial_replies():
+    from tigerbeetle_tpu.io.message_bus import TCPMessageBus
+    from tigerbeetle_tpu.vsr.client import Client
+
+    fake = _FakeReplica()
+    fake.script = _scripted_session(_barrage)
+    fake.start()
+
+    bus = TCPMessageBus([("127.0.0.1", fake.port)], 0xADE1)
+    client = Client(0xADE1, bus, replica_count=1)
+    client.register()
+    deadline = 200
+    while client.reply is None and deadline:
+        bus.pump(timeout=0.05)
+        deadline -= 1
+    assert client.reply is not None, "register reply lost"
+    client.take_reply()
+    assert client.session == 7
+
+    client.request(Operation.create_accounts, b"\x00" * 128)
+    deadline = 200
+    while client.reply is None and deadline:
+        bus.pump(timeout=0.05)
+        deadline -= 1
+    header, body = client.take_reply()
+    # ONLY the genuine reply surfaced: empty body, matching request number
+    assert body == b"" and header.request == 1
+    fake.join()
+
+
+def test_native_client_rejects_adversarial_replies():
+    from tigerbeetle_tpu.client_ffi import NativeClient
+
+    fake = _FakeReplica()
+    fake.script = _scripted_session(_barrage)
+    fake.start()
+
+    client = NativeClient("127.0.0.1", fake.port)
+    reply = client._request(Operation.create_accounts, b"\x00" * 128)
+    assert reply == b""  # the stale 8-byte body never surfaced
+    client.close()
+    fake.join()
+
+
+def test_python_client_ignores_wrong_command():
+    """A non-reply command (e.g. a spoofed prepare) must not satisfy the
+    in-flight request even with valid checksums."""
+    from tigerbeetle_tpu.io.message_bus import TCPMessageBus
+    from tigerbeetle_tpu.vsr.client import Client
+
+    def barrage(h):
+        spoof = Header(
+            command=int(Command.prepare), operation=h.operation,
+            request=h.request,
+        )
+        spoof.set_checksum_body(b"")
+        spoof.set_checksum()
+        return [spoof.to_bytes(), _reply(h.request, b"", h.operation)]
+
+    fake = _FakeReplica()
+    fake.script = _scripted_session(barrage)
+    fake.start()
+
+    bus = TCPMessageBus([("127.0.0.1", fake.port)], 0xADE2)
+    client = Client(0xADE2, bus, replica_count=1)
+    client.register()
+    deadline = 200
+    while client.reply is None and deadline:
+        bus.pump(timeout=0.05)
+        deadline -= 1
+    client.take_reply()
+    client.request(Operation.create_accounts, b"\x00" * 128)
+    deadline = 200
+    while client.reply is None and deadline:
+        bus.pump(timeout=0.05)
+        deadline -= 1
+    header, body = client.take_reply()
+    assert header.command == Command.reply and body == b""
+    fake.join()

@@ -142,6 +142,7 @@ def run_e2e(
     server_args: tuple[str, ...] = (),
     backend: str = "native",
     workload: str = "simple",
+    driver: str = "python",
     log=None,
 ) -> dict:
     """Format, start a real replica, drive the protocol, return metrics.
@@ -218,10 +219,16 @@ def run_e2e(
 
         drain_thread = threading.Thread(target=_drain_stdout, daemon=True)
         drain_thread.start()
-        result = _drive(
-            proc, port, n_accounts, n_transfers, batch, clients,
-            warmup_batches, log, workload=workload,
-        )
+        if driver == "async":
+            result = _drive_async(
+                port, n_accounts, n_transfers, batch, clients,
+                warmup_batches, log, workload=workload,
+            )
+        else:
+            result = _drive(
+                proc, port, n_accounts, n_transfers, batch, clients,
+                warmup_batches, log, workload=workload,
+            )
         # SIGTERM makes the server emit its [stats] line (group-commit hit
         # rate etc.); after exit the pipe hits EOF, so joining the drain
         # thread is deterministic (no sleep race). Dual mode drains the
@@ -258,6 +265,139 @@ def run_e2e(
         kill_process_group(proc)
         if own_tmp:
             tmp.cleanup()
+
+
+def _drive_async(port, n_accounts, n_transfers, batch, clients,
+                 warmup_batches, log, workload: str = "simple") -> dict:
+    """Drive the protocol through the ASYNC packet ABI (native/tb_client.cc
+    tb_client_async_*): ONE client process, one AsyncNativeClient whose
+    session pool keeps `clients` requests in flight — the reference's
+    packet/completion model replacing the Python per-session loop
+    (reference: src/clients/c/tb_client/packet.zig)."""
+    import threading as _threading
+
+    from tigerbeetle_tpu.client_ffi import AsyncNativeClient, NativeClient
+    from tigerbeetle_tpu.state_machine import decode_results
+
+    rng = np.random.default_rng(42)
+    addresses = f"127.0.0.1:{port}"
+    ctl = NativeClient(addresses)  # blocking control-plane session
+
+    t0 = time.monotonic()
+    next_id = 1
+    while next_id <= n_accounts:
+        n = min(batch, n_accounts - next_id + 1)
+        assert ctl._request(
+            Operation.create_accounts, _accounts_body(next_id, n)
+        ) == b"", "account create failed"
+        next_id += n
+    log(f"{n_accounts} accounts in {time.monotonic() - t0:.1f}s")
+
+    ac = AsyncNativeClient(addresses, sessions=clients)
+    log(f"async client up: {clients} pooled sessions")
+    try:
+        # -- build bodies (workload gen off the clock) --
+        n_batches = (n_transfers + batch - 1) // batch
+        nid = 1_000_000
+        if workload == "two_phase":
+            pends, posts = [], []
+            for _ in range((n_batches + 1) // 2):
+                pend = _transfers_body(rng, nid, batch, n_accounts, flags=2)
+                nid += batch
+                pends.append(pend)
+                posts.append(_post_body(pend, nid))
+                nid += batch
+            waves = [pends, posts]
+            posted_batches = len(posts)
+        else:
+            bodies = []
+            for _ in range(n_batches):
+                bodies.append(_transfers_body(rng, nid, batch, n_accounts))
+                nid += batch
+            waves = [bodies]
+            posted_batches = len(bodies)
+
+        # -- warmup (kernel compiles / cache warm): singles, then a full
+        # concurrent burst so fused group paths compile before the clock --
+        op = Operation.create_transfers
+        warm = 0
+        for _ in range(warmup_batches):
+            pend = _transfers_body(rng, nid, batch, n_accounts, flags=2)
+            nid += batch
+            assert ac.submit(op, pend).result(timeout=600) == b""
+            post = _post_body(pend, nid)
+            nid += batch
+            assert ac.submit(op, post).result(timeout=600) == b""
+            warm += 2
+        burst = [
+            _transfers_body(rng, nid + i * batch, batch, n_accounts)
+            for i in range(clients)
+        ]
+        nid += clients * batch
+        for f in [ac.submit(op, b) for b in burst]:
+            assert f.result(timeout=600) == b""
+        warm += clients
+        # warmup posted amounts: each pend+post pair posts ONE batch's
+        # amounts (the pend batch itself only moves pending), plus the
+        # simple burst batches
+        posted_batches += warmup_batches + clients
+        log(f"warmup done ({warm} batches); timing "
+            f"{sum(len(w) for w in waves)} batches")
+
+        # -- timed: submit with a bounded window (the pool keeps `clients`
+        # requests on the wire; the window keeps its queue fed without
+        # turning latency into pure queue depth) --
+        sem = _threading.Semaphore(clients * 2)
+        lat_ms: list[float] = []
+        lat_lock = _threading.Lock()
+        failures = 0
+        t_start = time.monotonic()
+        for wave in waves:
+            futs = []
+            for body in wave:
+                sem.acquire()
+                t_sub = time.monotonic()
+
+                def _done(_f, t=t_sub):
+                    with lat_lock:
+                        lat_ms.append((time.monotonic() - t) * 1e3)
+                    sem.release()
+
+                fut = ac.submit(op, body)
+                fut.add_done_callback(_done)
+                futs.append(fut)
+            for f in futs:  # wave barrier (two_phase: posts follow pends)
+                failures += len(decode_results(f.result(timeout=600), op))
+        wall = time.monotonic() - t_start
+        n_timed = sum(len(w) for w in waves) * batch
+        assert failures == 0, f"{failures} transfers failed"
+    finally:
+        ac.close()
+
+    # -- conservation over the wire (blocking control session) --
+    total = posted_batches * batch
+    dpo = cpo = found = 0
+    ids = list(range(1, n_accounts + 1))
+    for i in range(0, len(ids), 8000):
+        accounts = ctl.lookup_accounts(ids[i : i + 8000])
+        found += len(accounts)
+        dpo += sum(a.debits_posted for a in accounts)
+        cpo += sum(a.credits_posted for a in accounts)
+    assert found == n_accounts, (found, n_accounts)
+    assert dpo == cpo == total, (dpo, cpo, total)
+    log(f"conservation verified: {total} transfers, dpo==cpo=={total}")
+    ctl.close()
+
+    lat = np.percentile(lat_ms if lat_ms else [float("nan")],
+                        [0, 25, 50, 75, 100])
+    return {
+        "durable_tps": round(n_timed / wall, 1) if wall else 0.0,
+        "n_transfers": n_timed,
+        "wall_s": round(wall, 2),
+        "clients": clients,
+        "driver": "async_abi",
+        "latency_ms_p00_p25_p50_p75_p100": [round(float(x), 2) for x in lat],
+    }
 
 
 def _drive(proc, port, n_accounts, n_transfers, batch, clients,

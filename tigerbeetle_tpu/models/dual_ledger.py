@@ -239,8 +239,12 @@ class DualLedger:
                 "error": f"{type(e).__name__}: {e}",
             }
         chk_dev = int(np.asarray(self._chk_device_scalar))
-        # the native fold chain is complete once the engine worker idles
-        self.native.drain_many([])  # no-op; engine queue is FIFO
+        # Barrier through the engine's FIFO worker: a job submitted now
+        # starts only after every prior execute's future has resolved AND
+        # run its inline done-callbacks (the fold chain) on the worker
+        # thread — Future.result() alone wakes waiters BEFORE callbacks,
+        # so without this the last batch's fold could be missing.
+        self.native._submit(lambda: 0).result()
         with self._chk_lock:
             chk_nat = self._chk_native
         fp_nat = self.native.fingerprint()

@@ -1932,7 +1932,9 @@ class DeviceLedger(HostLedgerBase):
             return None
         if getattr(self, "_group_disabled", False):
             return None
-        items = items[: self.GROUP_KS[0]]
+        # never truncate silently: callers zip the returned pendings with
+        # their items — a shorter list would drop batches without a trace
+        assert len(items) <= self.GROUP_KS[0], (len(items), self.GROUP_KS)
         total = sum(len(arr) for _, arr in items)
         if self._xfer_used + total > self._xfer_limit:
             return None  # per-batch path raises the descriptive guard

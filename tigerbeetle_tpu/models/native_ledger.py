@@ -143,8 +143,9 @@ class NativeLedger:
         k = len(items)
         if k < 2:
             return None
-        items = items[: self.GROUP_MAX]
-        k = len(items)
+        # never truncate silently: callers zip the returned pendings with
+        # their items — a shorter list would drop batches without a trace
+        assert k <= self.GROUP_MAX, (k, self.GROUP_MAX)
         arrs = [np.ascontiguousarray(a) for _, a in items]
         codes = [np.empty(len(a), dtype=np.uint32) for a in arrs]
         fails = np.full(k, -1, dtype=np.int64)
