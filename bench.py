@@ -153,7 +153,7 @@ def bench_tracked_configs(stage) -> dict:
     from tigerbeetle_tpu.types import TRANSFER_DTYPE, Operation
 
     out = {}
-    rng = np.random.default_rng(77)
+    n_runs = int(os.environ.get("BENCH_CFG_RUNS", 3))
 
     def fresh(n_accounts=N_ACCOUNTS):
         process = ConfigProcess(account_slots_log2=16, transfer_slots_log2=22)
@@ -170,11 +170,11 @@ def bench_tracked_configs(stage) -> dict:
             next_id += k
         return ledger, ts
 
-    def run_batches(name, ledger, ts, batches, events_per_batch=BATCH,
-                    warmup=1):
+    def run_batches(ledger, ts, batches, events_per_batch=BATCH,
+                    warmup=1) -> float:
         """`warmup` batches absorb jit compiles and must exercise every tier
         the timed batches hit (two-phase passes 2: pending=fast,
-        post=fast_pv)."""
+        post=fast_pv). Returns the timed TPS."""
         pends = []
         for b in batches[:warmup]:
             ts += events_per_batch
@@ -187,11 +187,24 @@ def bench_tracked_configs(stage) -> dict:
             p = ledger.execute_async(Operation.create_transfers, ts, b)
             jax.block_until_ready(p.results)
             n += events_per_batch
-        out[name] = round(n / (time.perf_counter() - t0), 1)
-        return ts
+        return n / (time.perf_counter() - t0)
+
+    def median_config(name, one_run) -> None:
+        """Each tracked config runs N times over FRESH ledgers (kernels
+        are process-cached, so only run 1 pays compiles — its warmup
+        batches absorb them) and reports median + per-run values + spread
+        (round-4 verdict: single samples swung 2x between bench runs)."""
+        vals = [one_run(np.random.default_rng(77 + 13 * i))
+                for i in range(n_runs)]
+        med = float(np.median(vals))
+        out[name] = round(med, 1)
+        out[name + "_runs"] = [round(v, 1) for v in vals]
+        out[name + "_spread"] = (
+            round((max(vals) - min(vals)) / med, 4) if med else None
+        )
 
     # 1. read path: lookup_accounts over full id batches
-    with stage("cfg_lookup"):
+    def cfg_lookup(rng):
         ledger, ts = fresh()
         ids = ids_to_batch(
             [int(x) for x in rng.integers(1, N_ACCOUNTS + 1, size=BATCH)],
@@ -203,11 +216,14 @@ def bench_tracked_configs(stage) -> dict:
         for _ in range(20):
             found, rows, res = k(ledger.state, ids)
         jax.block_until_ready(found)
-        out["lookup_accounts_per_s"] = round(20 * BATCH / (time.perf_counter() - t0), 1)
+        return 20 * BATCH / (time.perf_counter() - t0)
+
+    with stage("cfg_lookup"):
+        median_config("lookup_accounts_per_s", cfg_lookup)
 
     # 2. two-phase: full pending batches (fast tier) then full post batches
     # (the VECTORIZED fast_pv tier — distinct prior-batch pendings)
-    with stage("cfg_two_phase"):
+    def cfg_two_phase(rng):
         ledger, ts = fresh()
         batches = []
         for g in range(4):
@@ -219,10 +235,13 @@ def bench_tracked_configs(stage) -> dict:
             post["pending_id_lo"] = pend["id_lo"]
             post["flags"] = 4  # post_pending_transfer
             batches += [pend, post]
-        ts = run_batches("two_phase_tps", ledger, ts, batches, warmup=2)
+        return run_batches(ledger, ts, batches, warmup=2)
+
+    with stage("cfg_two_phase"):
+        median_config("two_phase_tps", cfg_two_phase)
 
     # 3. linked chains: every batch is chains of 4 (exact serial tier)
-    with stage("cfg_chains"):
+    def cfg_chains(rng):
         ledger, ts = fresh()
         batches = []
         for g in range(3):
@@ -231,10 +250,13 @@ def bench_tracked_configs(stage) -> dict:
             b["flags"][3::4] = 0  # chain terminators every 4th event
             b["flags"][-1] = 0
             batches.append(b)
-        ts = run_batches("linked_chains_tps", ledger, ts, batches)
+        return run_batches(ledger, ts, batches)
+
+    with stage("cfg_chains"):
+        median_config("linked_chains_tps", cfg_chains)
 
     # 4. balancing: balancing_debit over funded accounts (exact serial tier)
-    with stage("cfg_balancing"):
+    def cfg_balancing(rng):
         ledger, ts = fresh()
         seed_batch = build_transfers(rng, 1, BATCH)  # fund accounts first
         ts += BATCH
@@ -244,12 +266,15 @@ def bench_tracked_configs(stage) -> dict:
             b = build_transfers(rng, 1 + (g + 1) * BATCH, BATCH)
             b["flags"] = 16  # balancing_debit
             batches.append(b)
-        ts = run_batches("balancing_tps", ledger, ts, batches)
+        return run_batches(ledger, ts, batches)
+
+    with stage("cfg_balancing"):
+        median_config("balancing_tps", cfg_balancing)
 
     # 5. mixed: ~88% simple transfers + ~6% posts (fast_pv lanes) + ~6%
     # linked-chain pairs on their own accounts -> the conflict-partitioned
     # SPLIT executor (fast_pv majority + compacted serial residue)
-    with stage("cfg_mixed"):
+    def cfg_mixed(rng):
         ledger, ts = fresh()
         pend0 = build_transfers(rng, 1, BATCH)
         pend0["flags"] = 2
@@ -291,18 +316,22 @@ def bench_tracked_configs(stage) -> dict:
             b["amount_lo"][post_lanes] = 0
             b["flags"][post_lanes] = 4
             batches.append(b)
-        ts = run_batches("mixed_split_tps", ledger, ts, batches)
+        tps = run_batches(ledger, ts, batches)
         out["split_stats"] = dict(ledger.hazards.split_stats)
         assert ledger.hazards.split_stats.get("split_pv", 0) >= 3, (
             "mixed config must exercise the split executor"
         )
+        return tps
+
+    with stage("cfg_mixed"):
+        median_config("mixed_split_tps", cfg_mixed)
 
     # 6. spill-active steady state: the transfer table's HBM budget is a
     # fraction of the workload, so the cold tail spills to the LSM forest
     # every few batches and the pre-commit reload path stays hot — the
     # bounded-memory cliff, measured rather than assumed.
     try:
-        _bench_spill_config(stage, out, rng)
+        _bench_spill_config(stage, out, np.random.default_rng(77))
     except Exception as e:  # never sink the whole benchmark
         out["spill_active_tps"] = 0.0
         out["spill_error"] = f"{type(e).__name__}: {e}"
@@ -312,12 +341,32 @@ def bench_tracked_configs(stage) -> dict:
 
 
 def _bench_spill_config(stage, out, rng) -> None:
+    import jax
+    import jax.numpy as jnp
+
     from tigerbeetle_tpu.constants import BATCH_PAD, TEST_CLUSTER, ConfigProcess
     from tigerbeetle_tpu.io.storage import MemoryStorage, ZoneLayout
     from tigerbeetle_tpu.lsm.grid import Grid
     from tigerbeetle_tpu.lsm.groove import Forest
     from tigerbeetle_tpu.models.ledger import DeviceLedger
     from tigerbeetle_tpu.types import Operation
+
+    # A/B transport probe (round-4 verdict: the "degraded transport" claim
+    # needs its isolating artifact, like the flagship's dispatch probe).
+    # The spill cycle performs this process's FIRST device->host fetch —
+    # measuring launch latency immediately before and after the first
+    # cycle separates "the tunnel degraded" from "the spill code is slow".
+    _pz = jnp.zeros(1, dtype=jnp.uint32)
+    _pf = jax.jit(lambda a, b: jnp.maximum(a, jnp.max(b)))
+    jax.block_until_ready(_pf(jnp.uint32(0), _pz))  # absorb the compile
+
+    def probe_dispatch(n=40):
+        x = jnp.uint32(0)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            x = _pf(x, _pz)
+        jax.block_until_ready(x)
+        return round((time.perf_counter() - t0) / n * 1e6, 1)  # us/launch
 
     with stage("cfg_spill"):
         layout = ZoneLayout(TEST_CLUSTER, grid_size=768 * 1024 * 1024)
@@ -352,14 +401,25 @@ def _bench_spill_config(stage, out, rng) -> None:
         ledger.drain(ledger.execute_async(
             Operation.create_transfers, ts2, warm_pend
         ))
+        probe = {"dispatch_us_fresh": probe_dispatch()}  # pre-first-cycle
         wg = 0
+        pre_spill_batch_s = []
         while ledger.spill.stats["cycles"] < 1 and wg < 8:
             warm = build_transfers(rng, 4_500_000 + wg * BATCH, BATCH)
             ts2 += BATCH
+            tb = time.perf_counter()
             ledger.drain(ledger.execute_async(
                 Operation.create_transfers, ts2, warm
             ))
+            if ledger.spill.stats["cycles"] == 0:  # pure commit, no cycle
+                pre_spill_batch_s.append(time.perf_counter() - tb)
             wg += 1
+        # the first cycle just fetched device rows: the process's first d2h
+        probe["dispatch_us_post_d2h"] = probe_dispatch()
+        if pre_spill_batch_s:
+            probe["commit_ms_best_pre_spill"] = round(
+                min(pre_spill_batch_s) * 1e3, 1
+            )
         warm_post = np.zeros(BATCH, dtype=warm_pend.dtype)
         warm_post["id_lo"] = np.arange(
             4_900_000, 4_900_000 + BATCH, dtype=np.uint64
@@ -371,6 +431,7 @@ def _bench_spill_config(stage, out, rng) -> None:
             Operation.create_transfers, ts2, warm_post
         ))
         pend_bodies = []
+        timed_batch_s = []
         t0 = time.perf_counter()
         for g in range(nbatches):
             if g < n_pend:
@@ -393,16 +454,34 @@ def _bench_spill_config(stage, out, rng) -> None:
             else:
                 b = build_transfers(rng, 6_000_000 + g * BATCH, BATCH)
             ts2 += BATCH
+            tb = time.perf_counter()
             ledger.drain(ledger.execute_async(
                 Operation.create_transfers, ts2, b
             ))
+            timed_batch_s.append(time.perf_counter() - tb)
             n_sp += BATCH
             # the checkpoint-cadence free-set apply: staged releases from
             # compaction churn become reusable, as the durable system's
-            # checkpoint chain would do (grid.py contract)
-            forest.grid.encode_free_set()
+            # checkpoint chain would do (grid.py contract). io_drain first:
+            # the spill-IO worker mutates the same lock-free grid/free-set
+            # (the SpillManager.checkpoint_meta pattern); every 4th batch,
+            # a real checkpoint cadence, so the drain barrier doesn't
+            # serialize every batch against the worker
+            if g % 4 == 3:
+                ledger.spill.io_drain()
+                forest.grid.encode_free_set()
         out["spill_active_tps"] = round(n_sp / (time.perf_counter() - t0), 1)
-        out["spill_stats"] = dict(ledger.spill.stats)
+        # best timed batch = a cycle-free post-d2h commit: against
+        # commit_ms_best_pre_spill it splits the bill between "the tunnel
+        # degraded every dispatch" and "cycles/reloads cost time"
+        probe["commit_ms_best_spill_active"] = round(
+            min(timed_batch_s) * 1e3, 1
+        )
+        out["spill_transport_probe"] = probe
+        out["spill_stats"] = {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in ledger.spill.stats.items()
+        }
         assert ledger.spill.stats["cycles"] >= 2, "spill never engaged"
         assert ledger.spill.stats["reloaded"] > 0, (
             "spill bench never exercised the reload path"

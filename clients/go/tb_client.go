@@ -217,6 +217,11 @@ type AsyncClient struct {
 	work     chan asyncWork
 	stop     chan struct{}
 	wg       sync.WaitGroup
+	// submitters in flight: Close drains the queue only after every
+	// concurrent submit has finished its send (a select may pick the
+	// buffered send even with stop already closed — without this barrier
+	// that work could land after the drain and never complete)
+	subWG sync.WaitGroup
 }
 
 // NewAsyncClient registers `sessions` sessions and starts their workers.
@@ -254,6 +259,8 @@ func NewAsyncClient(addresses string, cluster uint32, sessions int) (*AsyncClien
 
 func (a *AsyncClient) submit(op uint8, body []byte, replyCap int) chan AsyncResult {
 	done := make(chan AsyncResult, 1)
+	a.subWG.Add(1)
+	defer a.subWG.Done()
 	select {
 	case a.work <- asyncWork{op: op, body: body, replyCap: replyCap, done: done}:
 	case <-a.stop:
@@ -287,6 +294,7 @@ func (a *AsyncClient) SubmitCreateAccounts(accounts []Account) chan AsyncResult 
 func (a *AsyncClient) Close() {
 	close(a.stop)
 	a.wg.Wait()
+	a.subWG.Wait() // no send can land after this: the drain below is final
 	for {
 		select {
 		case w := <-a.work:

@@ -1,0 +1,404 @@
+// Java client for the tigerbeetle_tpu cluster: an FFI wrapper over the
+// tb_client C ABI (native/tb_client.{h,cc}) — the same layering as the
+// reference's Java client (reference: src/clients/java wraps
+// src/clients/c/tb_client.zig through JNI glue). Session registration,
+// retries, checksums, and wire framing live in the shared native library;
+// this file converts between TBTypes objects and the 128-byte
+// little-endian wire structs (field layout: TBTypes.java, generated from
+// the one schema by scripts/bindgen.py).
+//
+// Runtime: java.lang.foreign (the FFM API, final since JDK 22) — no JNI
+// glue, no extra jar. This repo's CI image has no JVM, so the client is
+// exercised where one exists; the exact C ABI call sequence it makes
+// (init signature, reply-capacity math, the empty-batch early return,
+// deinit) is replayed by tests/test_c_abi_sequence.py via ctypes
+// everywhere — the same coverage contract as the Go and Node clients.
+//
+// Usage:
+//   var c = new TBClient("127.0.0.1:3001", 0);
+//   var errs = c.createAccounts(accounts);   // sparse non-ok results
+//   c.close();
+
+package com.tigerbeetle;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.file.Path;
+import java.security.SecureRandom;
+import java.util.ArrayList;
+import java.util.List;
+import java.util.concurrent.CompletableFuture;
+import java.util.concurrent.ExecutorService;
+import java.util.concurrent.Executors;
+import java.util.concurrent.Semaphore;
+
+public final class TBClient implements AutoCloseable {
+    public static final int OP_CREATE_ACCOUNTS = 128;
+    public static final int OP_CREATE_TRANSFERS = 129;
+    public static final int OP_LOOKUP_ACCOUNTS = 130;
+    public static final int OP_LOOKUP_TRANSFERS = 131;
+
+    public static final int EVENT_SIZE = 128;
+    public static final int RESULT_SIZE = 8;
+    public static final int ID_SIZE = 16;
+
+    private static final Linker LINKER = Linker.nativeLinker();
+    private static MethodHandle hInit;
+    private static MethodHandle hRequest;
+    private static MethodHandle hDeinit;
+
+    private final Arena arena = Arena.ofShared();
+    private MemorySegment handle; // tb_client_t*
+
+    private static synchronized void loadNative(String libPath) {
+        if (hInit != null) return;
+        String path = libPath != null ? libPath
+            : Path.of(System.getProperty("tb.native",
+                "../../native/libtb_native.so")).toString();
+        SymbolLookup lib = SymbolLookup.libraryLookup(path, Arena.global());
+        // int tb_client_init(tb_client_t **out, const char *addresses,
+        //                    int port, uint32_t cluster,
+        //                    const uint8_t client_id[16])
+        hInit = LINKER.downcallHandle(
+            lib.find("tb_client_init").orElseThrow(),
+            FunctionDescriptor.of(ValueLayout.JAVA_INT,
+                ValueLayout.ADDRESS, ValueLayout.ADDRESS,
+                ValueLayout.JAVA_INT, ValueLayout.JAVA_INT,
+                ValueLayout.ADDRESS));
+        // int tb_client_request(tb_client_t *c, uint8_t op, const void
+        //   *body, uint64_t body_len, void *reply, uint64_t reply_cap,
+        //   uint64_t *reply_len)
+        hRequest = LINKER.downcallHandle(
+            lib.find("tb_client_request").orElseThrow(),
+            FunctionDescriptor.of(ValueLayout.JAVA_INT,
+                ValueLayout.ADDRESS, ValueLayout.JAVA_BYTE,
+                ValueLayout.ADDRESS, ValueLayout.JAVA_LONG,
+                ValueLayout.ADDRESS, ValueLayout.JAVA_LONG,
+                ValueLayout.ADDRESS));
+        // void tb_client_deinit(tb_client_t *c)
+        hDeinit = LINKER.downcallHandle(
+            lib.find("tb_client_deinit").orElseThrow(),
+            FunctionDescriptor.ofVoid(ValueLayout.ADDRESS));
+    }
+
+    /** addresses: "host:port[,host:port...]"; cluster id must match the
+     *  data file's. The client id is 16 random nonzero bytes. */
+    public TBClient(String addresses, int cluster) {
+        this(addresses, cluster, null);
+    }
+
+    public TBClient(String addresses, int cluster, String libPath) {
+        loadNative(libPath);
+        byte[] id = new byte[16];
+        new SecureRandom().nextBytes(id);
+        id[0] |= 1; // nonzero
+        MemorySegment out = arena.allocate(ValueLayout.ADDRESS);
+        MemorySegment addr = arena.allocateFrom(addresses);
+        MemorySegment cid = arena.allocate(16);
+        MemorySegment.copy(id, 0, cid, ValueLayout.JAVA_BYTE, 0, 16);
+        try {
+            int rc = (int) hInit.invokeExact(out, addr, 0, cluster, cid);
+            if (rc != 0)
+                throw new RuntimeException("tb_client_init: errno " + (-rc));
+        } catch (RuntimeException e) {
+            throw e;
+        } catch (Throwable t) {
+            throw new RuntimeException(t);
+        }
+        handle = out.get(ValueLayout.ADDRESS, 0);
+    }
+
+    @Override
+    public synchronized void close() {
+        if (handle == null) return;
+        try {
+            hDeinit.invokeExact(handle);
+        } catch (Throwable t) {
+            throw new RuntimeException(t);
+        }
+        handle = null;
+        arena.close();
+    }
+
+    private byte[] request(int op, byte[] body, int replyCap) {
+        // the Go/Node wrappers' guard: zero reply capacity -> no call
+        if (replyCap == 0) return new byte[0];
+        try (Arena call = Arena.ofConfined()) {
+            MemorySegment bodySeg = body.length == 0
+                ? MemorySegment.NULL : call.allocate(body.length);
+            if (body.length != 0)
+                MemorySegment.copy(body, 0, bodySeg, ValueLayout.JAVA_BYTE,
+                    0, body.length);
+            MemorySegment reply = call.allocate(replyCap);
+            MemorySegment len = call.allocate(ValueLayout.JAVA_LONG);
+            int rc;
+            try {
+                rc = (int) hRequest.invokeExact(handle, (byte) op, bodySeg,
+                    (long) body.length, reply, (long) replyCap, len);
+            } catch (Throwable t) {
+                throw new RuntimeException(t);
+            }
+            if (rc != 0)
+                throw new RuntimeException(
+                    "tb_client_request: errno " + (-rc));
+            int n = (int) len.get(ValueLayout.JAVA_LONG, 0);
+            byte[] outBytes = new byte[n];
+            MemorySegment.copy(reply, ValueLayout.JAVA_BYTE, 0, outBytes,
+                0, n);
+            return outBytes;
+        }
+    }
+
+    // -- wire struct packing (layouts: tigerbeetle_tpu/types.py dtypes) --
+
+    private static ByteBuffer wire(int n) {
+        return ByteBuffer.allocate(n).order(ByteOrder.LITTLE_ENDIAN);
+    }
+
+    private static void putU128(ByteBuffer b, byte[] v) {
+        if (v == null) { b.putLong(0).putLong(0); return; }
+        if (v.length != ID_SIZE)
+            throw new IllegalArgumentException("u128 must be 16 bytes LE");
+        b.put(v);
+    }
+
+    private static byte[] getU128(ByteBuffer b) {
+        byte[] v = new byte[ID_SIZE];
+        b.get(v);
+        return v;
+    }
+
+    /** Little-endian u128 from a non-negative long (convenience). */
+    public static byte[] u128(long lo) {
+        ByteBuffer b = wire(ID_SIZE);
+        b.putLong(lo).putLong(0);
+        return b.array();
+    }
+
+    static byte[] packAccount(TBTypes.Account a) {
+        ByteBuffer b = wire(EVENT_SIZE);
+        putU128(b, a.id);
+        putU128(b, a.debits_pending);
+        putU128(b, a.debits_posted);
+        putU128(b, a.credits_pending);
+        putU128(b, a.credits_posted);
+        putU128(b, a.user_data_128);
+        b.putLong(a.user_data_64).putInt(a.user_data_32).putInt(a.reserved)
+            .putInt(a.ledger).putShort(a.code).putShort(a.flags)
+            .putLong(a.timestamp);
+        return b.array();
+    }
+
+    static TBTypes.Account unpackAccount(ByteBuffer b) {
+        TBTypes.Account a = new TBTypes.Account();
+        a.id = getU128(b);
+        a.debits_pending = getU128(b);
+        a.debits_posted = getU128(b);
+        a.credits_pending = getU128(b);
+        a.credits_posted = getU128(b);
+        a.user_data_128 = getU128(b);
+        a.user_data_64 = b.getLong();
+        a.user_data_32 = b.getInt();
+        a.reserved = b.getInt();
+        a.ledger = b.getInt();
+        a.code = b.getShort();
+        a.flags = b.getShort();
+        a.timestamp = b.getLong();
+        return a;
+    }
+
+    static byte[] packTransfer(TBTypes.Transfer t) {
+        ByteBuffer b = wire(EVENT_SIZE);
+        putU128(b, t.id);
+        putU128(b, t.debit_account_id);
+        putU128(b, t.credit_account_id);
+        putU128(b, t.amount);
+        putU128(b, t.pending_id);
+        putU128(b, t.user_data_128);
+        b.putLong(t.user_data_64).putInt(t.user_data_32).putInt(t.timeout)
+            .putInt(t.ledger).putShort(t.code).putShort(t.flags)
+            .putLong(t.timestamp);
+        return b.array();
+    }
+
+    static TBTypes.Transfer unpackTransfer(ByteBuffer b) {
+        TBTypes.Transfer t = new TBTypes.Transfer();
+        t.id = getU128(b);
+        t.debit_account_id = getU128(b);
+        t.credit_account_id = getU128(b);
+        t.amount = getU128(b);
+        t.pending_id = getU128(b);
+        t.user_data_128 = getU128(b);
+        t.user_data_64 = b.getLong();
+        t.user_data_32 = b.getInt();
+        t.timeout = b.getInt();
+        t.ledger = b.getInt();
+        t.code = b.getShort();
+        t.flags = b.getShort();
+        t.timestamp = b.getLong();
+        return t;
+    }
+
+    private static List<TBTypes.CreateAccountsResult> unpackResults(
+            byte[] reply) {
+        ByteBuffer b = ByteBuffer.wrap(reply)
+            .order(ByteOrder.LITTLE_ENDIAN);
+        List<TBTypes.CreateAccountsResult> out = new ArrayList<>();
+        while (b.remaining() >= RESULT_SIZE) {
+            TBTypes.CreateAccountsResult r =
+                new TBTypes.CreateAccountsResult();
+            r.index = b.getInt();
+            r.result = b.getInt();
+            out.add(r);
+        }
+        return out;
+    }
+
+    // -- the five operations (sparse non-ok results; found rows in
+    //    request order with missing ids skipped) --
+
+    public List<TBTypes.CreateAccountsResult> createAccounts(
+            List<TBTypes.Account> accounts) {
+        ByteBuffer body = wire(accounts.size() * EVENT_SIZE);
+        for (TBTypes.Account a : accounts) body.put(packAccount(a));
+        return unpackResults(request(OP_CREATE_ACCOUNTS, body.array(),
+            accounts.size() * RESULT_SIZE));
+    }
+
+    public List<TBTypes.CreateAccountsResult> createTransfers(
+            List<TBTypes.Transfer> transfers) {
+        ByteBuffer body = wire(transfers.size() * EVENT_SIZE);
+        for (TBTypes.Transfer t : transfers) body.put(packTransfer(t));
+        return unpackResults(request(OP_CREATE_TRANSFERS, body.array(),
+            transfers.size() * RESULT_SIZE));
+    }
+
+    public List<TBTypes.Account> lookupAccounts(List<byte[]> ids) {
+        ByteBuffer body = wire(ids.size() * ID_SIZE);
+        for (byte[] id : ids) putU128(body, id);
+        byte[] reply = request(OP_LOOKUP_ACCOUNTS, body.array(),
+            ids.size() * EVENT_SIZE);
+        ByteBuffer b = ByteBuffer.wrap(reply)
+            .order(ByteOrder.LITTLE_ENDIAN);
+        List<TBTypes.Account> out = new ArrayList<>();
+        while (b.remaining() >= EVENT_SIZE) out.add(unpackAccount(b));
+        return out;
+    }
+
+    public List<TBTypes.Transfer> lookupTransfers(List<byte[]> ids) {
+        ByteBuffer body = wire(ids.size() * ID_SIZE);
+        for (byte[] id : ids) putU128(body, id);
+        byte[] reply = request(OP_LOOKUP_TRANSFERS, body.array(),
+            ids.size() * EVENT_SIZE);
+        ByteBuffer b = ByteBuffer.wrap(reply)
+            .order(ByteOrder.LITTLE_ENDIAN);
+        List<TBTypes.Transfer> out = new ArrayList<>();
+        while (b.remaining() >= EVENT_SIZE) out.add(unpackTransfer(b));
+        return out;
+    }
+
+    // -- async session pool (the reference's packet/completion model;
+    //    same shape as the Go goroutine pool and the Node libuv pool:
+    //    N sessions, each blocking request on a pool thread, submits
+    //    resolve as CompletableFutures) --
+
+    public static final class AsyncClient implements AutoCloseable {
+        private final List<TBClient> sessions = new ArrayList<>();
+        private final Semaphore free;
+        private final ExecutorService pool;
+        private volatile boolean closing;
+
+        public AsyncClient(String addresses, int cluster, int nSessions) {
+            if (nSessions < 1 || nSessions > 32)
+                throw new IllegalArgumentException("1..32 sessions");
+            for (int i = 0; i < nSessions; i++)
+                sessions.add(new TBClient(addresses, cluster));
+            free = new Semaphore(nSessions, true);
+            pool = Executors.newFixedThreadPool(nSessions);
+        }
+
+        private <T> CompletableFuture<T> withSession(
+                java.util.function.Function<TBClient, T> fn) {
+            if (closing)
+                return CompletableFuture.failedFuture(
+                    new IllegalStateException("async client closed"));
+            CompletableFuture<T> fut = new CompletableFuture<>();
+            try {
+                submitTask(fut, fn);
+            } catch (java.util.concurrent.RejectedExecutionException e) {
+                // close() raced us: fail the future instead of throwing
+                fut.completeExceptionally(
+                    new IllegalStateException("async client closed", e));
+            }
+            return fut;
+        }
+
+        private <T> void submitTask(CompletableFuture<T> fut,
+                java.util.function.Function<TBClient, T> fn) {
+            pool.submit(() -> {
+                try {
+                    free.acquire();
+                    TBClient c;
+                    synchronized (sessions) {
+                        c = sessions.remove(sessions.size() - 1);
+                    }
+                    try {
+                        fut.complete(fn.apply(c));
+                    } finally {
+                        synchronized (sessions) {
+                            sessions.add(c);
+                        }
+                        free.release();
+                    }
+                } catch (Throwable t) {
+                    fut.completeExceptionally(t);
+                }
+            });
+        }
+
+        public CompletableFuture<List<TBTypes.CreateAccountsResult>>
+                createAccounts(List<TBTypes.Account> accounts) {
+            return withSession(c -> c.createAccounts(accounts));
+        }
+
+        public CompletableFuture<List<TBTypes.CreateAccountsResult>>
+                createTransfers(List<TBTypes.Transfer> transfers) {
+            return withSession(c -> c.createTransfers(transfers));
+        }
+
+        public CompletableFuture<List<TBTypes.Account>> lookupAccounts(
+                List<byte[]> ids) {
+            return withSession(c -> c.lookupAccounts(ids));
+        }
+
+        public CompletableFuture<List<TBTypes.Transfer>> lookupTransfers(
+                List<byte[]> ids) {
+            return withSession(c -> c.lookupTransfers(ids));
+        }
+
+        /** Waits for in-flight requests (a deinit mid-request would be a
+         *  use-after-free), then deinits every session. */
+        @Override
+        public void close() {
+            closing = true;
+            pool.shutdown();
+            try {
+                pool.awaitTermination(60,
+                    java.util.concurrent.TimeUnit.SECONDS);
+            } catch (InterruptedException e) {
+                Thread.currentThread().interrupt();
+            }
+            synchronized (sessions) {
+                for (TBClient c : sessions) c.close();
+                sessions.clear();
+            }
+        }
+    }
+}

@@ -1,0 +1,84 @@
+// Sample: two-phase transfer lifecycle through the Java client
+// (mirrors clients/go/sample/main.go and clients/node/sample/main.js).
+//
+// Run against a live cluster (JDK 22+, FFM is final):
+//   javac -d build clients/java/TBTypes.java clients/java/TBClient.java \
+//         clients/java/sample/Sample.java
+//   java -cp build --enable-native-access=ALL-UNNAMED \
+//        -Dtb.native=native/libtb_native.so \
+//        com.tigerbeetle.Sample 127.0.0.1:3001
+
+package com.tigerbeetle;
+
+import java.util.List;
+
+public final class Sample {
+    static void check(boolean ok, String what) {
+        if (!ok) throw new AssertionError(what);
+    }
+
+    static long u128lo(byte[] v) {
+        long lo = 0;
+        for (int i = 7; i >= 0; i--) lo = (lo << 8) | (v[i] & 0xffL);
+        return lo;
+    }
+
+    public static void main(String[] args) {
+        String addresses = args.length > 0 ? args[0] : "127.0.0.1:3001";
+        try (TBClient c = new TBClient(addresses, 0)) {
+            TBTypes.Account a1 = new TBTypes.Account();
+            a1.id = TBClient.u128(1);
+            a1.ledger = 1;
+            a1.code = 1;
+            TBTypes.Account a2 = new TBTypes.Account();
+            a2.id = TBClient.u128(2);
+            a2.ledger = 1;
+            a2.code = 1;
+            check(c.createAccounts(List.of(a1, a2)).isEmpty(),
+                "createAccounts errors");
+
+            // pending, then partial post (two-phase; reference:
+            // src/state_machine.zig:907-1014)
+            TBTypes.Transfer pend = new TBTypes.Transfer();
+            pend.id = TBClient.u128(100);
+            pend.debit_account_id = TBClient.u128(1);
+            pend.credit_account_id = TBClient.u128(2);
+            pend.amount = TBClient.u128(500);
+            pend.ledger = 1;
+            pend.code = 1;
+            pend.flags = 1 << 1; // pending
+            pend.timeout = 3600;
+            check(c.createTransfers(List.of(pend)).isEmpty(),
+                "pending transfer errors");
+
+            TBTypes.Transfer post = new TBTypes.Transfer();
+            post.id = TBClient.u128(101);
+            post.pending_id = TBClient.u128(100);
+            post.amount = TBClient.u128(300);
+            post.ledger = 1;
+            post.code = 1;
+            post.flags = 1 << 2; // post_pending_transfer
+            check(c.createTransfers(List.of(post)).isEmpty(), "post errors");
+
+            List<TBTypes.Account> accounts = c.lookupAccounts(
+                List.of(TBClient.u128(1), TBClient.u128(2)));
+            check(accounts.size() == 2, "accounts found");
+            check(u128lo(accounts.get(0).debits_posted) == 300,
+                "debits_posted");
+            check(u128lo(accounts.get(1).credits_posted) == 300,
+                "credits_posted");
+            check(u128lo(accounts.get(0).debits_pending) == 0,
+                "pending released");
+
+            List<TBTypes.Transfer> transfers = c.lookupTransfers(
+                List.of(TBClient.u128(100), TBClient.u128(101)));
+            check(transfers.size() == 2, "transfers found");
+            check(u128lo(transfers.get(1).amount) == 300, "posted amount");
+
+            // empty batch is a no-op, not an error
+            check(c.createAccounts(List.of()).isEmpty(), "empty batch");
+
+            System.out.println("java sample: OK");
+        }
+    }
+}

@@ -270,6 +270,7 @@ class AsyncClient {
         // ffi-napi also exposes .async on bound functions; normalize
         c._requestAsync = (op, body, cap) =>
           new Promise((resolve, reject) => {
+            if (cap === 0) return resolve(Buffer.alloc(0)); // empty batch
             const reply = Buffer.alloc(cap);
             const lenPtr = c._native.ref.alloc("uint64");
             c._native.lib.tb_client_request.async(
@@ -283,6 +284,7 @@ class AsyncClient {
       } else {
         c._requestAsync = (op, body, cap) =>
           new Promise((resolve, reject) => {
+            if (cap === 0) return resolve(Buffer.alloc(0)); // empty batch
             const reply = Buffer.alloc(cap);
             const lenOut = [0n];
             c._native.request.async(

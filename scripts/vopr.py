@@ -1,18 +1,66 @@
 #!/usr/bin/env python
-"""The VOPR fleet runner (reference: src/vopr.zig): run batches of
-simulator seeds, report failures with their replay seed.
+"""The VOPR fleet runner (reference: src/vopr.zig + src/simulator.zig:66-152):
+run batches of simulator seeds, each with a seed-derived random topology
+(1-6 replicas, 0-2 standbys, 1-8 clients) and fault mix (partitions, torn
+writes, WAL/replies/superblock faults combined; a slice of seeds runs the
+device backend with grid faults). Failures report their replay seed — the
+seed alone reproduces topology, workload, and fault schedule.
 
-Usage: python scripts/vopr.py [--seeds N] [--start S] [--ticks T] [--device]
+Usage: python scripts/vopr.py [--seeds N] [--start S] [--ticks T]
+         [--device-fraction F] [--fixed] [--json PATH]
+
+--fixed pins the legacy 3-replica/2-client topology (pre-round-5 behavior)
+for bisecting topology-dependent failures; --json appends one record per
+seed for the VOPR hub (scripts/vopr_hub.py).
 """
 
 import argparse
+import json
 import sys
 import time
+import traceback
 
 sys.path.insert(0, ".")
 import tests.conftest  # noqa: F401, E402 — CPU platform before jax init
 
-from tigerbeetle_tpu.testing.simulator import run_simulation  # noqa: E402
+from tigerbeetle_tpu.testing.simulator import (  # noqa: E402
+    describe_options,
+    random_options,
+    run_simulation,
+)
+
+
+def run_seed(seed: int, ticks: int, device_fraction: float,
+             fixed: bool,
+             verify_fraction: float = 0.25,
+             ) -> tuple[dict | None, str, str | None]:
+    """(stats, topology-line, error) for one seed. A `verify_fraction`
+    slice of seeds runs with the intensive online-verification tier
+    (constants.VERIFY — reference src/constants.zig:592): hash-chain
+    re-checks at commit, LSM level audits, journal read-after-write,
+    oracle conservation audits."""
+    from tigerbeetle_tpu import constants
+
+    if fixed:
+        opts: dict = {}
+        desc = "fixed r3+s0 c2 oracle"
+        verify = False
+    else:
+        opts = random_options(seed, device_fraction=device_fraction)
+        verify = (seed * 2654435761 % 100) < verify_fraction * 100
+        desc = describe_options(opts) + (" VERIFY" if verify else "")
+    kw = {"ticks": ticks, **opts}
+    prev, constants.VERIFY = constants.VERIFY, verify or constants.VERIFY
+    try:
+        return run_simulation(seed, **kw), desc, None
+    except Exception as e:  # noqa: BLE001 — report and continue the fleet
+        frame = traceback.extract_tb(e.__traceback__)[-1]
+        return None, desc, (
+            f"{type(e).__name__}: {e} "
+            f"[{frame.filename.rsplit('/', 1)[-1]}:{frame.lineno}]"
+        )
+    finally:
+        constants.VERIFY = prev
 
 
 def main() -> int:
@@ -20,32 +68,56 @@ def main() -> int:
     ap.add_argument("--seeds", type=int, default=20)
     ap.add_argument("--start", type=int, default=1)
     ap.add_argument("--ticks", type=int, default=1000)
-    ap.add_argument("--device", action="store_true",
-                    help="device-ledger backend (slow)")
+    ap.add_argument("--device-fraction", type=float, default=0.0,
+                    help="fraction of seeds on the DeviceLedger backend "
+                         "with grid faults (slow; needs jax)")
+    ap.add_argument("--fixed", action="store_true",
+                    help="legacy fixed topology (3 replicas / 2 clients)")
+    ap.add_argument("--json", default=None,
+                    help="append one JSON record per seed (vopr_hub input)")
     args = ap.parse_args()
 
     failures = []
+    sink = open(args.json, "a") if args.json else None
     t0 = time.time()
     for seed in range(args.start, args.start + args.seeds):
-        kw = {}
-        if args.device:
-            kw["backend_factory"] = None
-            kw["n_clients"] = 1
-        try:
-            stats = run_simulation(seed, ticks=args.ticks, **kw)
+        stats, desc, err = run_seed(
+            seed, args.ticks, args.device_fraction, args.fixed
+        )
+        if err is None:
             print(
-                f"seed {seed:6d} ok: committed={stats['committed_ops']:5d} "
+                f"seed {seed:6d} ok [{desc}]: "
+                f"committed={stats['committed_ops']:5d} "
                 f"replies={stats['replies']:5d} crashes={stats['crashes']} "
-                f"wal_faults={stats['wal_faults']} view={stats['view']}"
+                f"wal_faults={stats['wal_faults']} "
+                f"torn={stats['torn_writes']} "
+                f"grid={stats['grid_faults']} view={stats['view']}"
             )
-        except Exception as e:  # noqa: BLE001 — report and continue the fleet
+        else:
             failures.append(seed)
-            print(f"seed {seed:6d} FAIL: {type(e).__name__}: {str(e)[:160]}")
+            print(f"seed {seed:6d} FAIL [{desc}]: {err[:240]}")
+        if sink:
+            rec = {"seed": seed, "ticks": args.ticks, "topology": desc,
+                   "device_fraction": args.device_fraction,
+                   "fixed": args.fixed, "ok": err is None}
+            rec["error" if err else "stats"] = err or stats
+            sink.write(json.dumps(rec) + "\n")
+            sink.flush()
     dt = time.time() - t0
     print(f"\n{args.seeds - len(failures)}/{args.seeds} passed in {dt:.0f}s")
     if failures:
-        print(f"replay failures with: python scripts/vopr.py --start <seed> --seeds 1")
+        # the replay must carry the SAME mode flags — the seed's topology
+        # draw depends on device_fraction/fixed, not the seed alone
+        extra = ""
+        if args.device_fraction:
+            extra += f" --device-fraction {args.device_fraction}"
+        if args.fixed:
+            extra += " --fixed"
+        print("replay failures with: python scripts/vopr.py "
+              f"--start <seed> --seeds 1 --ticks {args.ticks}{extra}")
         print(f"failing seeds: {failures}")
+    if sink:
+        sink.close()
     return 1 if failures else 0
 
 

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""The VOPR hub (reference: src/vopr_hub/ — a service that receives
+crashing simulator seeds, dedupes them, replays each to confirm, and
+files an issue per unique failure).
+
+This is the single-process form: it ingests the JSONL records a fleet run
+emits (`python scripts/vopr.py --seeds N --json fleet.jsonl`), groups
+failures by signature (exception type + digit-normalized message — the
+same crash at different ops/views is one bug), optionally REPLAYS one
+representative seed per group to confirm the failure reproduces from the
+seed alone, and files one markdown report per unique failure under
+vopr_issues/ with the replay command.
+
+Usage:
+  python scripts/vopr.py --seeds 200 --json fleet.jsonl
+  python scripts/vopr_hub.py fleet.jsonl --replay --out vopr_issues
+"""
+
+import argparse
+import hashlib
+import json
+import re
+import sys
+from pathlib import Path
+
+sys.path.insert(0, ".")
+
+
+def signature(error: str) -> str:
+    """Stable failure signature: exception type + message with runs of
+    digits and hex collapsed (op numbers, views, checksums vary per seed;
+    the SHAPE of the failure is the bug)."""
+    head = error.split("\n", 1)[0][:200]
+    norm = re.sub(r"0x[0-9a-fA-F]+", "0xN", head)
+    norm = re.sub(r"\d+", "N", norm)
+    return norm
+
+
+def sig_id(sig: str) -> str:
+    return hashlib.sha256(sig.encode()).hexdigest()[:12]
+
+
+def ingest(path: str) -> dict[str, dict]:
+    """JSONL fleet records -> {signature: {sig, records}} for failures."""
+    groups: dict[str, dict] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("ok"):
+                continue
+            sig = signature(rec["error"])
+            g = groups.setdefault(sig, {"sig": sig, "records": []})
+            g["records"].append(rec)
+    return groups
+
+
+def replay(rec: dict) -> tuple[bool, str | None]:
+    """Re-run one failing record's seed with the SAME mode flags the
+    fleet used (recorded per seed — the topology draw depends on
+    device_fraction/fixed, not the seed alone)."""
+    from scripts.vopr import run_seed
+
+    _, _, err = run_seed(
+        rec["seed"], rec["ticks"],
+        device_fraction=rec.get("device_fraction", 0.0),
+        fixed=rec.get(
+            "fixed", rec["topology"].startswith("fixed")
+        ),
+    )
+    return err is not None, err
+
+
+def file_report(group: dict, out_dir: Path,
+                replay_result: tuple[bool, str | None] | None) -> Path:
+    sid = sig_id(group["sig"])
+    recs = group["records"]
+    path = out_dir / f"{sid}.md"
+    lines = [
+        f"# VOPR failure {sid}",
+        "",
+        f"**Signature:** `{group['sig']}`",
+        f"**Seeds:** {len(recs)} "
+        f"({', '.join(str(r['seed']) for r in recs[:12])}"
+        f"{', ...' if len(recs) > 12 else ''})",
+        "",
+    ]
+    if replay_result is not None:
+        ok, err = replay_result
+        lines += [
+            f"**Replay:** {'REPRODUCED' if ok else 'did NOT reproduce'}"
+            + (f" — `{(err or '')[:160]}`" if ok else ""),
+            "",
+        ]
+    lines += ["## Per-seed detail", ""]
+    for r in recs[:20]:
+        extra = ""
+        if r.get("device_fraction"):
+            extra += f" --device-fraction {r['device_fraction']}"
+        if r.get("fixed"):
+            extra += " --fixed"
+        lines += [
+            f"- seed `{r['seed']}` ticks={r['ticks']} "
+            f"[{r['topology']}]: `{r['error'][:200]}`",
+            f"  replay: `python scripts/vopr.py --start {r['seed']} "
+            f"--seeds 1 --ticks {r['ticks']}{extra}`",
+        ]
+    path.write_text("\n".join(lines) + "\n")
+    return path
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("fleet_jsonl")
+    ap.add_argument("--out", default="vopr_issues")
+    ap.add_argument("--replay", action="store_true",
+                    help="replay one seed per unique failure to confirm")
+    args = ap.parse_args()
+
+    groups = ingest(args.fleet_jsonl)
+    if not groups:
+        print("no failures in fleet log")
+        return 0
+    out_dir = Path(args.out)
+    out_dir.mkdir(exist_ok=True)
+    for sig, group in sorted(groups.items()):
+        rr = replay(group["records"][0]) if args.replay else None
+        path = file_report(group, out_dir, rr)
+        print(f"{sig_id(sig)}: {len(group['records'])} seed(s) -> {path}")
+    print(f"{len(groups)} unique failure(s) filed in {out_dir}/")
+    return 2  # failures exist
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -8,13 +8,8 @@ corrupted-body reply, a stale-request-number reply, and a truncated frame,
 then the genuine reply — both the Python vsr client and the native C
 client must surface ONLY the genuine one."""
 
-import os
 import socket
-import struct
 import threading
-
-import numpy as np
-import pytest
 
 from tigerbeetle_tpu.types import Operation
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header

@@ -5,7 +5,6 @@ same-op create packets coalesced into one message and their sparse results
 demuxed per packet with rebased indices."""
 
 import os
-import signal
 import subprocess
 import sys
 

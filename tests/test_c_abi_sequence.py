@@ -1,11 +1,12 @@
 """C-ABI call-sequence coverage for the FFI clients (VERDICT r3 item 7).
 
-The Go (clients/go/tb_client.go) and Node (clients/node/tb_client.js)
-clients are thin wrappers over the tb_client C ABI, but this image ships
-neither toolchain — so this test replays their EXACT call sequences
-(argument shapes, reply-capacity math, empty-batch guard, deinit) via
-ctypes against a live server. A C-ABI change that would break either
-client breaks here, in every CI environment.
+The Go (clients/go/tb_client.go), Node (clients/node/tb_client.js), and
+Java (clients/java/TBClient.java) clients are thin wrappers over the
+tb_client C ABI, but this image ships none of those toolchains — so this
+test replays their EXACT call sequences (argument shapes, reply-capacity
+math, empty-batch guard, deinit) via ctypes against a live server. A
+C-ABI change that would break any of them breaks here, in every CI
+environment.
 """
 
 import ctypes
@@ -74,8 +75,9 @@ def _request(lib, handle, op: int, body: bytes, reply_cap: int):
 
 def test_abi_sequence_two_phase(server):
     """The Go sample's sequence (clients/go/sample/main.go) == the Node
-    sample's (clients/node/sample/main.js): create accounts, pending,
-    partial post, lookups, empty batch, exists code, deinit."""
+    sample's (clients/node/sample/main.js) == the Java sample's
+    (clients/java/sample/Sample.java): create accounts, pending, partial
+    post, lookups, empty batch, exists code, deinit."""
     lib, handle = _init(server)
     try:
         acc = types.accounts_to_np([

@@ -1,0 +1,67 @@
+"""The VOPR hub (scripts/vopr_hub.py; reference: src/vopr_hub/ — dedupe
+crashing seeds by signature, replay to confirm, file one issue each)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from scripts.vopr_hub import ingest, sig_id, signature
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+
+def test_signature_normalizes_varying_numbers():
+    a = signature("AssertionError: history fork at op 17 (replica 2)")
+    b = signature("AssertionError: history fork at op 9301 (replica 0)")
+    assert a == b
+    c = signature("AssertionError: checksum 0xdeadbeef != 0xcafe")
+    d = signature("AssertionError: checksum 0x1234 != 0x99")
+    assert c == d
+    assert a != c
+
+
+def test_ingest_groups_and_files_reports(tmp_path):
+    fleet = tmp_path / "fleet.jsonl"
+    recs = [
+        {"seed": 1, "ticks": 100, "topology": "r3+s0 c2x4 oracle", "ok": True,
+         "stats": {}},
+        {"seed": 2, "ticks": 100, "topology": "r2+s1 c1x4 oracle", "ok": False,
+         "error": "AssertionError: history fork at op 12 (replica 1)"},
+        {"seed": 3, "ticks": 100, "topology": "r4+s0 c3x2 oracle", "ok": False,
+         "error": "AssertionError: history fork at op 99 (replica 3)"},
+        {"seed": 4, "ticks": 100, "topology": "r1+s0 c2x8 oracle", "ok": False,
+         "error": "ValueError: Sample larger than population or is negative"},
+    ]
+    fleet.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    groups = ingest(str(fleet))
+    assert len(groups) == 2  # two unique signatures, seeds 2+3 deduped
+    fork = [g for g in groups.values() if "fork" in g["sig"]][0]
+    assert [r["seed"] for r in fork["records"]] == [2, 3]
+
+    # the CLI files one report per signature and exits 2 (failures exist)
+    out = tmp_path / "issues"
+    p = subprocess.run(
+        [sys.executable, "scripts/vopr_hub.py", str(fleet),
+         "--out", str(out)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 2, p.stderr
+    reports = list(out.glob("*.md"))
+    assert len(reports) == 2
+    body = "\n".join(r.read_text() for r in reports)
+    assert "--start 2" in body and "--start 4" in body
+    assert sig_id(fork["sig"]) in body
+
+
+def test_hub_clean_fleet_exits_zero(tmp_path):
+    fleet = tmp_path / "fleet.jsonl"
+    fleet.write_text(json.dumps(
+        {"seed": 1, "ticks": 100, "topology": "r3", "ok": True, "stats": {}}
+    ) + "\n")
+    p = subprocess.run(
+        [sys.executable, "scripts/vopr_hub.py", str(fleet)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr
+    assert "no failures" in p.stdout

@@ -9,6 +9,16 @@ per-replica tuning knobs.
 from __future__ import annotations
 
 import dataclasses
+import os
+
+# Intensive online-verification tier (reference: src/constants.zig:592
+# `constants.verify` compiles extra invariant checks into hot paths).
+# TB_VERIFY=1 enables: LSM level-invariant audits after every compaction,
+# journal read-after-write verification, replica hash-chain re-checks at
+# commit, and periodic conservation audits in the oracle state machine.
+# Tests may also toggle `constants.VERIFY` directly; hot paths read it at
+# check time, not import time.
+VERIFY = os.environ.get("TB_VERIFY", "0") == "1"
 
 U64_MAX = (1 << 64) - 1
 U128_MAX = (1 << 128) - 1

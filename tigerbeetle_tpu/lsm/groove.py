@@ -77,11 +77,15 @@ class Groove:
         # IdTree: id (big-endian u128) -> timestamp (reference IdTreeValue)
         self.ids = Tree(grid, ID_SIZE, TS_SIZE, memtable_max,
                         manifest_log=manifest_log, tree_id=tid.get("id", 0))
-        # Secondary index trees: (field_be ++ ts_be) -> presence byte
+        # Secondary index trees: (field_be ++ ts_be) -> presence byte.
+        # filters=False: index trees are range-scanned only (query()), and
+        # bloom filters serve point lookups — building them was ~30% of a
+        # spill cycle's LSM bill for nothing.
         self.index_spec = {name: (off, w) for name, off, w in index_fields}
         self.indexes = {
             name: Tree(grid, w + TS_SIZE, 1, memtable_max,
-                       manifest_log=manifest_log, tree_id=tid.get(name, 0))
+                       manifest_log=manifest_log, tree_id=tid.get(name, 0),
+                       filters=False)
             for name, off, w in index_fields
         }
         # prefetch cache: id -> row (the CacheMap residency contract:
@@ -113,11 +117,11 @@ class Groove:
             )
 
     def insert_bulk(self, rows_u8, timestamps) -> None:
-        """Vectorized bulk insert of n wire rows (np.uint8 [n, 128]) with
+        """Array-native bulk insert of n wire rows (np.uint8 [n, 128]) with
         their timestamps (np.uint64 [n]) — the spill cycle's write path.
         Key construction is numpy byte-slicing (big-endian composite keys
-        built column-wise); each tree takes ONE put_many. Equivalent to n
-        insert() calls, ~50x cheaper in Python overhead."""
+        built column-wise); each tree takes ONE put_array — no per-entry
+        Python objects from here through the on-disk table write."""
         import numpy as np
 
         n = len(rows_u8)
@@ -127,33 +131,16 @@ class Groove:
         ts_be = np.ascontiguousarray(
             timestamps.astype(">u8")
         ).view(np.uint8).reshape(n, TS_SIZE)
-        ts_flat = ts_be.tobytes()
-        ts_keys = [
-            ts_flat[i * TS_SIZE : (i + 1) * TS_SIZE] for i in range(n)
-        ]
-        rows_flat = rows_u8.tobytes()
-        self.objects.put_many(
-            ts_keys,
-            [rows_flat[i * OBJECT_SIZE : (i + 1) * OBJECT_SIZE]
-             for i in range(n)],
-        )
+        self.objects.put_array(ts_be, rows_u8)
         # id key: the 16 LE bytes at offset 0, reversed -> BE u128
-        id_be = rows_u8[:, ID_SIZE - 1 :: -1]  # [n, 16] reversed
-        id_flat = np.ascontiguousarray(id_be).tobytes()
-        self.ids.put_many(
-            [id_flat[i * ID_SIZE : (i + 1) * ID_SIZE] for i in range(n)],
-            ts_keys,
-        )
+        id_be = np.ascontiguousarray(rows_u8[:, ID_SIZE - 1 :: -1])
+        self.ids.put_array(id_be, ts_be)
         for name, (off, w) in self.index_spec.items():
             field_be = rows_u8[:, off + w - 1 : (off - 1 if off else None) : -1]
             comp = np.concatenate(
                 [np.ascontiguousarray(field_be), ts_be], axis=1
             )
-            sz = w + TS_SIZE
-            flat = comp.tobytes()
-            self.indexes[name].put_many(
-                [flat[i * sz : (i + 1) * sz] for i in range(n)], b"\x00"
-            )
+            self.indexes[name].put_array(comp, b"\x00")
 
     def upsert(self, id_: int, timestamp: int, row: bytes,
                old_row: bytes | None = None) -> None:

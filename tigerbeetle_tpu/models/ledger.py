@@ -508,6 +508,21 @@ def _set_ts_words(rows, ts):
 # ----------------------------------------------------------------------
 
 
+_KERNELS_CACHE: dict = {}
+
+
+def get_kernels(process: "ConfigProcess") -> "LedgerKernels":
+    """One LedgerKernels per table geometry, process-wide. The kernels are
+    stateless (closed over slot counts only); sharing them means a fresh
+    DeviceLedger reuses every jitted function's compile cache — a
+    median-of-N bench run or a 300-test CI session compiles each kernel
+    ONCE instead of once per ledger."""
+    k = _KERNELS_CACHE.get(process)
+    if k is None:
+        k = _KERNELS_CACHE[process] = LedgerKernels(process)
+    return k
+
+
 class LedgerKernels:
     """Compiled commit kernels closed over the table geometry.
 
@@ -1704,11 +1719,12 @@ class DeviceLedger(HostLedgerBase):
         mode: str = "auto",
         forest=None,
         spill_keep_frac: float = 0.25,
+        spill_async_io: bool = True,
     ):
         self.cluster = cluster
         self.process = process
         self.mode = mode
-        self.kernels = LedgerKernels(process)
+        self.kernels = get_kernels(process)
         self.state = init_state(process)
         self.prepare_timestamp = 0
         self.pad_to: int | None = None  # fix the batch pad (bench: 8192)
@@ -1720,7 +1736,8 @@ class DeviceLedger(HostLedgerBase):
         if forest is not None:
             from tigerbeetle_tpu.models.spill import SpillManager
 
-            self.spill = SpillManager(self, forest, keep_frac=spill_keep_frac)
+            self.spill = SpillManager(self, forest, keep_frac=spill_keep_frac,
+                                      async_io=spill_async_io)
         # Host-tracked occupancy for the load-factor guard (1/2 max — the
         # probe-window unresolve probability is ~alpha^window, so alpha <= 1/2
         # with window 32 makes window overflow a ~2^-32 event; see
@@ -1830,8 +1847,9 @@ class DeviceLedger(HostLedgerBase):
     def _summarize_fn(self):
         """Jitted (results, fault, n) -> (packed results+fault, [count,
         fault]): ONE dispatch for the post-kernel bookkeeping (the previous
-        out-of-jit concatenate was its own XLA launch per batch)."""
-        fn = getattr(self, "_summarize_cache", None)
+        out-of-jit concatenate was its own XLA launch per batch). Cached on
+        the SHARED kernels object so fresh ledgers reuse the compile."""
+        fn = getattr(self.kernels, "_summarize_cache", None)
         if fn is None:
             def s(results, fault, n):
                 res = results.astype(jnp.uint32)
@@ -1843,7 +1861,7 @@ class DeviceLedger(HostLedgerBase):
                 packed = jnp.concatenate([res, f])
                 return packed, jnp.concatenate([cnt.reshape(1), f])
 
-            fn = self._summarize_cache = jax.jit(s)
+            fn = self.kernels._summarize_cache = jax.jit(s)
         return fn
 
     def _execute_split(self, arr, n, n_pad, nn, ts, timestamp: int, slow_mask,
@@ -1890,9 +1908,9 @@ class DeviceLedger(HostLedgerBase):
         (group commit: the replica coalesces its pipeline the way the
         flagship benchmark K-fuses device-generated batches). Returns
         (state', flat results [k * n_pad + 1]; last word = fault)."""
-        cache = getattr(self, "_group_cache", None)
+        cache = getattr(self.kernels, "_group_cache", None)
         if cache is None:
-            cache = self._group_cache = {}
+            cache = self.kernels._group_cache = {}
         fn = cache.get((k, n_pad))
         if fn is None:
             kernels = self.kernels
@@ -2147,6 +2165,7 @@ class DeviceLedger(HostLedgerBase):
             for i in range(len(arr))
         }
         if self.spill is not None and self.spill.spilled:
+            self.spill.io_drain()  # queued inserts must land before scans
             g = self.spill.forest.transfers
             for ts in g.query(field, value):
                 if ts in by_ts:

@@ -184,7 +184,36 @@ class OracleStateMachine:
 
         assert chain is None
         assert not chain_broken
+        from tigerbeetle_tpu import constants
+
+        if constants.VERIFY:
+            self._audit_count = getattr(self, "_audit_count", 0) + 1
+            if self._audit_count % 8 == 0:
+                self.verify_conservation()
         return results
+
+    def verify_conservation(self) -> None:
+        """Intensive-tier audit (constants.VERIFY; reference
+        src/constants.zig:592): per ledger, total debits_posted ==
+        total credits_posted and total debits_pending ==
+        total credits_pending — money never appears or vanishes.
+        O(accounts) per audit, run on a commit cadence."""
+        per_ledger: dict[int, list[int]] = {}
+        for a in self.accounts.values():
+            t = per_ledger.setdefault(a.ledger, [0, 0, 0, 0])
+            t[0] += a.debits_posted
+            t[1] += a.credits_posted
+            t[2] += a.debits_pending
+            t[3] += a.credits_pending
+        for ledger, (dp, cp, dpe, cpe) in per_ledger.items():
+            assert dp == cp, (
+                f"VERIFY: ledger {ledger} posted conservation broken: "
+                f"debits {dp} != credits {cp}"
+            )
+            assert dpe == cpe, (
+                f"VERIFY: ledger {ledger} pending conservation broken: "
+                f"debits {dpe} != credits {cpe}"
+            )
 
     def execute_dense(
         self, operation: Operation, timestamp: int, events: list

@@ -30,6 +30,10 @@ account-table guard stays hard.
 
 from __future__ import annotations
 
+import os
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -49,6 +53,18 @@ U32 = jnp.uint32
 ROW_WORDS = 32
 
 CHUNK = 8192  # static shape of gather/reload kernels (= BATCH_PAD)
+
+
+_SPILL_KERNELS_CACHE: dict = {}
+
+
+def get_spill_kernels(process) -> "SpillKernels":
+    """One SpillKernels per table geometry (stateless; same contract as
+    models.ledger.get_kernels — fresh managers reuse the jit cache)."""
+    k = _SPILL_KERNELS_CACHE.get(process)
+    if k is None:
+        k = _SPILL_KERNELS_CACHE[process] = SpillKernels(process)
+    return k
 
 
 class SpillKernels:
@@ -108,12 +124,13 @@ class SpillManager:
     spilled rows into lookups/extract.
     """
 
-    def __init__(self, ledger, forest, keep_frac: float = 0.25):
+    def __init__(self, ledger, forest, keep_frac: float = 0.25,
+                 async_io: bool = True):
         assert 0.0 < keep_frac < 1.0
         self.ledger = ledger
         self.forest = forest
         self.keep_frac = keep_frac
-        self.kernels = SpillKernels(ledger.process)
+        self.kernels = get_spill_kernels(ledger.process)
         # ids present ONLY in the LSM store (reloading removes the id; the
         # stale LSM row is overwritten on the next spill of that id).
         self.spilled: set[int] = set()
@@ -125,7 +142,49 @@ class SpillManager:
         # ride the superblock meta — the trailer pattern, reference:
         # src/vsr/superblock.zig:31-34).
         self._id_chain: list[int] = []
-        self.stats = {"cycles": 0, "spilled": 0, "reloaded": 0}
+        # t_* keys: cumulative seconds per cycle stage (the spill bench's
+        # isolating artifact — which part of the cycle carries the bill)
+        self.stats = {
+            "cycles": 0, "spilled": 0, "reloaded": 0,
+            "t_scan": 0.0, "t_gather_d2h": 0.0, "t_stage": 0.0,
+            "t_rebuild": 0.0, "t_reload": 0.0, "t_lsm_worker": 0.0,
+        }
+        # Async IO executor (reference: ALL storage IO rides one event
+        # loop off the replica's hot path, src/io/linux.zig:17-42): the
+        # spill cycle hands LSM insertion to ONE worker (FIFO = the insert
+        # order is deterministic) and commit continues as soon as the d2h
+        # gather lands. Rows in flight sit in _staged (id -> (row, ful));
+        # _fetch checks _staged first and barriers on the queue before any
+        # direct forest read. TB_SPILL_SYNC=1 forces inline IO (debugging).
+        self._io: ThreadPoolExecutor | None = (
+            None
+            if not async_io or os.environ.get("TB_SPILL_SYNC") == "1"
+            else ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="spill-io"
+            )
+        )
+        self._io_jobs: list[Future] = []
+        self._staged: dict[int, tuple[np.ndarray, int]] = {}
+        self._staged_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # the IO executor seam
+    # ------------------------------------------------------------------
+
+    def _io_submit(self, fn, *args) -> None:
+        if self._io is None:
+            fn(*args)
+            return
+        self._io_jobs.append(self._io.submit(fn, *args))
+
+    def io_drain(self) -> None:
+        """Barrier: every queued LSM job has run (and surfaced its
+        exception, if any). After this the forest is safe to read inline —
+        only the commit thread submits jobs, so none can appear while the
+        caller holds the drained state."""
+        jobs, self._io_jobs = self._io_jobs, []
+        for f in jobs:
+            f.result()
 
     # ------------------------------------------------------------------
     # membership
@@ -186,7 +245,14 @@ class SpillManager:
             self._reload_rows(reload_ids)
 
     def _fetch(self, id_: int) -> tuple[bytes, int]:
-        """One spilled row + fulfill byte from the LSM store."""
+        """One spilled row + fulfill byte: the in-flight staging area
+        first (no barrier), then the LSM store (barrier: the queued
+        inserts must land before a direct forest read)."""
+        with self._staged_lock:
+            hit = self._staged.get(id_)
+        if hit is not None:
+            return hit[0].tobytes(), hit[1]
+        self.io_drain()
         g = self.forest.transfers
         ts_key = g.ids.get(g._id_key(id_))
         assert ts_key is not None, f"spilled id {id_} missing from LSM"
@@ -195,7 +261,36 @@ class SpillManager:
         ful = self.forest.posted.get(ts_key)
         return row, (ful[0] if ful else 0)
 
+    def _fetch_many(self, ids: list[int], rows: np.ndarray,
+                    ful: np.ndarray) -> None:
+        """Fill rows[:k]/ful[:k] for `ids`: staged hits copied without a
+        barrier, the rest read from the forest after ONE io_drain."""
+        missing: list[tuple[int, int]] = []
+        with self._staged_lock:
+            for i, id_ in enumerate(ids):
+                hit = self._staged.get(id_)
+                if hit is not None:
+                    rows[i] = hit[0]
+                    ful[i] = hit[1]
+                else:
+                    missing.append((i, id_))
+        if not missing:
+            return
+        self.io_drain()
+        g = self.forest.transfers
+        for i, id_ in missing:
+            ts_key = g.ids.get(g._id_key(id_))
+            assert ts_key is not None, f"spilled id {id_} missing from LSM"
+            row = g.objects.get(ts_key)
+            assert row is not None
+            rows[i] = np.frombuffer(row, dtype=np.uint32)
+            f = self.forest.posted.get(ts_key)
+            ful[i] = f[0] if f else 0
+
     def _reload_rows(self, ids: list[int]) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
         led = self.ledger
         st = led.state
         for start in range(0, len(ids), CHUNK):
@@ -204,10 +299,7 @@ class SpillManager:
             pad = CHUNK if len(ids) > CHUNK else _next_pow2(k)
             rows = np.zeros((pad, ROW_WORDS), dtype=np.uint32)
             ful = np.zeros(pad, dtype=np.uint32)
-            for i, id_ in enumerate(chunk):
-                row_bytes, f = self._fetch(id_)
-                rows[i] = np.frombuffer(row_bytes, dtype=np.uint32)
-                ful[i] = f
+            self._fetch_many(chunk, rows, ful)
             active = np.zeros(pad, dtype=bool)
             active[:k] = True
             (
@@ -222,6 +314,49 @@ class SpillManager:
                 self.spilled.discard(id_)
             led._xfer_used += k
             self.stats["reloaded"] += k
+        self.stats["t_reload"] += _time.perf_counter() - t0
+
+    def _stage_and_submit(self, rows: np.ndarray, ful: np.ndarray,
+                          ids_lo: np.ndarray, ids_hi: np.ndarray,
+                          ts_np: np.ndarray) -> None:
+        """Stage one gathered cold chunk (rows visible to _fetch at once)
+        and queue its LSM insertion on the IO worker. The job unstages
+        only entries it staged itself (identity check): a later cycle may
+        re-spill an id and overwrite the staged tuple before this job
+        lands — its newer insert is FIFO-behind ours, so the LSM ends
+        newest-wins either way."""
+        k = len(rows)
+        entries: dict[int, tuple] = {}
+        with self._staged_lock:
+            for i in range(k):
+                key = int(ids_lo[i]) | (int(ids_hi[i]) << 64)
+                tup = (rows[i], int(ful[i]))
+                self._staged[key] = tup
+                entries[key] = tup
+
+        def job():
+            import time as _time
+
+            t0 = _time.perf_counter()
+            g = self.forest.transfers
+            g.insert_bulk(rows.view(np.uint8).reshape(k, 128), ts_np)
+            nz = np.nonzero(ful)[0]
+            if len(nz):
+                self.forest.posted.put_array(
+                    np.ascontiguousarray(
+                        ts_np[nz].astype(">u8")
+                    ).view(np.uint8).reshape(len(nz), 8),
+                    ful[nz].astype(np.uint8).reshape(len(nz), 1),
+                )
+            with self._staged_lock:
+                for key, tup in entries.items():
+                    if self._staged.get(key) is tup:
+                        del self._staged[key]
+            # worker-thread seconds (accumulated under the stats lock's
+            # coarse protection — a float add race would only smear stats)
+            self.stats["t_lsm_worker"] += _time.perf_counter() - t0
+
+        self._io_submit(job)
 
     # ------------------------------------------------------------------
     # the spill cycle
@@ -232,8 +367,11 @@ class SpillManager:
         table with the hot tail, guaranteeing room for `need` new rows.
         A host-paced maintenance op (the analog of the reference's paced
         compaction beats trading throughput for bounded memory)."""
+        import time as _time
+
         led = self.ledger
         st = led.state
+        t0 = _time.perf_counter()
         fault = int(np.asarray(st["fault"]))
         if fault:
             raise_on_fault(fault, "spill cycle")
@@ -259,11 +397,16 @@ class SpillManager:
         hot = occ & (ts >= watermark)
         cold_idx = np.nonzero(cold)[0].astype(np.int32)
         hot_idx = np.nonzero(hot)[0].astype(np.int32)
+        self.stats["t_scan"] += _time.perf_counter() - t0
+        t0 = _time.perf_counter()
 
-        # 1. Cold rows -> LSM (host pull; BULK insert into groove + posted
-        # tree — vectorized key construction + one put_many per tree; the
-        # per-row Python loop this replaces dominated the whole cycle).
-        g = self.forest.transfers
+        # 1. Cold rows -> host. The d2h gather is synchronous (the spilled
+        # set must be exact before the next admit()), pipelined across
+        # chunks; LSM insertion is NOT — rows stage in _staged and the IO
+        # worker drains them into the forest while commits continue
+        # (reference keeps all storage IO off the replica's hot path,
+        # src/io/linux.zig:17-42).
+        gathered = []
         for start in range(0, len(cold_idx), CHUNK):
             idx = cold_idx[start : start + CHUNK]
             idx_pad = np.full(CHUNK, self.kernels.t_dump, dtype=np.int32)
@@ -271,10 +414,19 @@ class SpillManager:
             rows_d, ful_d = self.kernels.gather(
                 st["xfer_rows"], st["fulfill"], jnp.asarray(idx_pad)
             )
+            for buf in (rows_d, ful_d):
+                try:
+                    buf.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    pass
+            gathered.append((idx, rows_d, ful_d))
+        for idx, rows_d, ful_d in gathered:
             # ascontiguousarray: some backends (axon) hand back arrays the
             # later .view(uint8) reinterpretation rejects
             rows = np.ascontiguousarray(np.asarray(rows_d)[: len(idx)])
-            ful = np.asarray(ful_d)[: len(idx)]
+            ful = np.ascontiguousarray(np.asarray(ful_d)[: len(idx)])
+            self.stats["t_gather_d2h"] += _time.perf_counter() - t0
+            t0 = _time.perf_counter()
             ids_lo = rows[:, 0].astype(np.uint64) | (
                 rows[:, 1].astype(np.uint64) << np.uint64(32)
             )
@@ -284,20 +436,14 @@ class SpillManager:
             ts_np = rows[:, 30].astype(np.uint64) | (
                 rows[:, 31].astype(np.uint64) << np.uint64(32)
             )
-            g.insert_bulk(rows.view(np.uint8).reshape(len(idx), 128), ts_np)
-            ful_nz = np.nonzero(ful)[0]
-            if len(ful_nz):
-                ts_be = ts_np[ful_nz].astype(">u8").view(np.uint8)
-                flat = ts_be.tobytes()
-                self.forest.posted.put_many(
-                    [flat[i * 8 : (i + 1) * 8] for i in range(len(ful_nz))],
-                    [bytes([int(x)]) for x in ful[ful_nz]],
-                )
+            self._stage_and_submit(rows, ful, ids_lo, ids_hi, ts_np)
             self.spilled.update(
                 (int(lo) | (int(hi) << 64))
                 for lo, hi in zip(ids_lo, ids_hi)
             )
             self.stats["spilled"] += len(idx)
+            self.stats["t_stage"] += _time.perf_counter() - t0
+            t0 = _time.perf_counter()
 
         # 2. Rebuild: fresh table, reinsert the hot tail (device-to-device;
         #    hot rows never visit the host).
@@ -334,6 +480,7 @@ class SpillManager:
         self._lo = np.sort(
             np.array([x & ((1 << 64) - 1) for x in self.spilled], dtype=np.uint64)
         )
+        self.stats["t_rebuild"] += _time.perf_counter() - t0
         self.stats["cycles"] += 1
 
     # ------------------------------------------------------------------
@@ -343,7 +490,8 @@ class SpillManager:
     def merge_lookup_rows(self, ids: list[int], found: np.ndarray,
                           rows: np.ndarray) -> bytes:
         """Reply body: wire rows in request order, HBM hits from the device
-        lookup, spilled hits from the LSM store, misses skipped."""
+        lookup, spilled hits from the LSM store, misses skipped (_fetch
+        barriers internally when it must read the forest)."""
         out = []
         for i, id_ in enumerate(ids):
             if found[i]:
@@ -354,6 +502,7 @@ class SpillManager:
 
     def extract_into(self, transfers: dict, posted: dict) -> None:
         """Merge spilled rows into extract() results (parity surface)."""
+        self.io_drain()
         for id_ in self.spilled:
             row, ful = self._fetch(id_)
             t = types.Transfer.from_np(
@@ -377,6 +526,7 @@ class SpillManager:
         staged releases apply)."""
         from tigerbeetle_tpu.lsm.grid import BLOCK_PAYLOAD_MAX
 
+        self.io_drain()  # queued inserts are part of this checkpoint
         g = self.forest.grid
         for address in self._id_chain:
             g.release(address)  # staged until the encode below
@@ -396,6 +546,9 @@ class SpillManager:
         }
 
     def restore(self, meta: dict) -> None:
+        self.io_drain()
+        with self._staged_lock:
+            self._staged.clear()
         self.forest.restore(meta["manifest"])
         self._id_chain = list(meta["spilled_blocks"])
         self.spilled = set()

@@ -105,6 +105,8 @@ class PacketSimulator(Network):
         if not (o.partition_probability > 0
                 and self.rng.random() < o.partition_probability):
             return
+        if self.replica_count < 2:
+            return  # single-replica cluster: no links to cut (VOPR r1 draw)
         mode = self.rng.choice(list(o.partition_modes))
         symmetric = self.rng.random() < o.partition_symmetry_probability
         n = self.replica_count

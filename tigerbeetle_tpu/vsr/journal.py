@@ -66,6 +66,15 @@ class Journal:
             Zone.wal_prepares, slot * self.msg_max, header.to_bytes() + body
         )
         self._write_header(slot, header)
+        from tigerbeetle_tpu import constants
+
+        if constants.VERIFY:
+            # intensive tier: read-after-write — the slot must round-trip
+            # through the storage seam with both checksums intact
+            got = self.read_prepare(header.op)
+            assert got is not None and got[0].checksum == header.checksum, (
+                f"VERIFY: prepare op {header.op} failed read-after-write"
+            )
 
     def _write_header(self, slot: int, header: Header) -> None:
         off = slot * HEADER_SIZE

@@ -117,8 +117,13 @@ class Replica:
                     offset=storage.layout.forest_offset,
                     block_count=storage.layout.forest_blocks,
                 ), memtable_max=getattr(process, "lsm_memtable_max", 2048))
+            # spill_async_io=False: the replica itself reads/writes the
+            # grid (scrub, peer repair, state sync) on its event loop —
+            # a concurrent spill-IO worker would race those accesses, and
+            # seeded simulator runs must not depend on thread timing
             backend = DeviceLedger(cluster, process, mode=mode,
-                                   forest=self.forest)
+                                   forest=self.forest,
+                                   spill_async_io=False)
         if hasattr(backend, "prefetch_results"):
             # the replica drains results to serve replies: start copies at
             # dispatch (a fetch-free driver like the flagship bench must
@@ -1355,6 +1360,18 @@ class Replica:
                 self._request_prepare(op, self.primary_index)
                 return
             header, body = got
+            from tigerbeetle_tpu import constants as _constants
+
+            if _constants.VERIFY and self.commit_checksum:
+                # intensive tier (constants.VERIFY): the hash chain is
+                # re-verified at the moment of commit, not only during
+                # recovery — a journal slot swapped after its write (or a
+                # repair that fetched the wrong timeline) dies here
+                assert header.parent == self.commit_checksum, (
+                    f"VERIFY: hash chain break at commit op {op}: "
+                    f"parent {header.parent:#x} != "
+                    f"commit_checksum {self.commit_checksum:#x}"
+                )
             try:
                 if self.commit_window > 0:
                     self._inflight.append(self._commit_dispatch(header, body))
