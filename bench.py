@@ -353,9 +353,12 @@ def _bench_spill_config(stage, out, rng) -> None:
 
     # A/B transport probe (round-4 verdict: the "degraded transport" claim
     # needs its isolating artifact, like the flagship's dispatch probe).
-    # The spill cycle performs this process's FIRST device->host fetch —
-    # measuring launch latency immediately before and after the first
-    # cycle separates "the tunnel degraded" from "the spill code is slow".
+    # This config is the bench's only phase that DRAINS every batch — and
+    # the first drain is this process-section's first device->host fetch,
+    # the cliff that permanently degrades the tunnel. Probing launch
+    # latency before ANY drain, after the first drain, and after the first
+    # spill cycle separates "any reply-serving d2h degrades the transport"
+    # from "the spill machinery is slow".
     _pz = jnp.zeros(1, dtype=jnp.uint32)
     _pf = jax.jit(lambda a, b: jnp.maximum(a, jnp.max(b)))
     jax.block_until_ready(_pf(jnp.uint32(0), _pz))  # absorb the compile
@@ -367,6 +370,8 @@ def _bench_spill_config(stage, out, rng) -> None:
             x = _pf(x, _pz)
         jax.block_until_ready(x)
         return round((time.perf_counter() - t0) / n * 1e6, 1)  # us/launch
+
+    probe = {"dispatch_us_fresh": probe_dispatch()}  # pre-ANY-d2h
 
     with stage("cfg_spill"):
         layout = ZoneLayout(TEST_CLUSTER, grid_size=768 * 1024 * 1024)
@@ -401,7 +406,8 @@ def _bench_spill_config(stage, out, rng) -> None:
         ledger.drain(ledger.execute_async(
             Operation.create_transfers, ts2, warm_pend
         ))
-        probe = {"dispatch_us_fresh": probe_dispatch()}  # pre-first-cycle
+        # the drain above was the first d2h: THE transport cliff
+        probe["dispatch_us_post_first_drain"] = probe_dispatch()
         wg = 0
         pre_spill_batch_s = []
         while ledger.spill.stats["cycles"] < 1 and wg < 8:
@@ -414,8 +420,9 @@ def _bench_spill_config(stage, out, rng) -> None:
             if ledger.spill.stats["cycles"] == 0:  # pure commit, no cycle
                 pre_spill_batch_s.append(time.perf_counter() - tb)
             wg += 1
-        # the first cycle just fetched device rows: the process's first d2h
-        probe["dispatch_us_post_d2h"] = probe_dispatch()
+        # after the first spill cycle's own gathers: unchanged from the
+        # post-drain value when the cycle adds no further transport damage
+        probe["dispatch_us_post_first_cycle"] = probe_dispatch()
         if pre_spill_batch_s:
             probe["commit_ms_best_pre_spill"] = round(
                 min(pre_spill_batch_s) * 1e3, 1
@@ -855,6 +862,16 @@ def main() -> None:
                 "durable_device_tps": e2e.get("durable_device_tps", 0.0),
                 "group_commit_hit_rate": e2e.get("group_commit_hit_rate", 0.0),
                 "spill_active_tps": configs.get("spill_active_tps", 0.0),
+                # [fresh, post-first-d2h] us/launch: the transport cliff
+                # that caps every reply-serving device path on this rig
+                "spill_dispatch_cliff_us": [
+                    configs.get("spill_transport_probe", {}).get(
+                        "dispatch_us_fresh"
+                    ),
+                    configs.get("spill_transport_probe", {}).get(
+                        "dispatch_us_post_first_drain"
+                    ),
+                ],
             }
         )
     )

@@ -417,7 +417,9 @@ def test_group_commit_matches_oracle():
         c.request(Operation.create_transfers, arr.tobytes())
     cluster.network.run()
     r.pump_commits()
-    assert r.ledger._group_cache, "group kernel was never used"
+    # per-REPLICA counter (the kernels object is shared process-wide, so
+    # its compile cache says nothing about THIS replica's behavior)
+    assert r.group_stats["fused_ops"] > 0, "group commit never fused"
     r.flush_commits()
     cluster.network.run()
     for c in clients:

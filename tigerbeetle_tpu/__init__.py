@@ -24,9 +24,36 @@ NOTE: importing this package enables jax_enable_x64 (u64 limbs are the
 native word of the whole framework).
 """
 
+import os as _os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: the 8192-batch commit kernels take tens
+# of seconds to compile (remote compile on tunneled TPUs), and every server
+# process the bench/tests spawn used to pay that again. With the cache, the
+# first process compiles and every later one loads from disk in <1s —
+# including the dual-mode device shadow, whose in-window compile otherwise
+# stalls the reply path once the shadow queue fills. TB_JAX_CACHE=''
+# disables; default lives inside the repo (gitignored).
+_cache = _os.environ.get("TB_JAX_CACHE")
+if _cache is None:
+    _repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    _cache = (
+        _os.path.join(_repo, ".jax_cache")
+        if _os.access(_repo, _os.W_OK)  # source checkout
+        # installed package (site-packages may be read-only): user cache
+        else _os.path.join(
+            _os.path.expanduser("~"), ".cache", "tigerbeetle_tpu", "jax"
+        )
+    )
+if _cache:
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax without the knob: compiles stay per-process
 
 from tigerbeetle_tpu import constants, types  # noqa: E402,F401
 

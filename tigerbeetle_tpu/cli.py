@@ -175,7 +175,10 @@ def cmd_start(args) -> int:
         from tigerbeetle_tpu.models.dual_ledger import DualLedger
 
         backend_factory = lambda: DualLedger(  # noqa: E731
-            args.account_slots_log2, args.transfer_slots_log2
+            args.account_slots_log2, args.transfer_slots_log2,
+            # compiles happen at boot, before "listening" — an in-window
+            # compile stalls the shadow queue into the reply path
+            warm_kernels=True,
         )
     elif args.backend == "sharded":
         import jax

@@ -116,12 +116,14 @@ class Groove:
                 self._index_key(off, w, row, ts_key), b"\x00"
             )
 
-    def insert_bulk(self, rows_u8, timestamps) -> None:
+    def insert_bulk(self, rows_u8, timestamps, settle: bool = True) -> None:
         """Array-native bulk insert of n wire rows (np.uint8 [n, 128]) with
         their timestamps (np.uint64 [n]) — the spill cycle's write path.
         Key construction is numpy byte-slicing (big-endian composite keys
         built column-wise); each tree takes ONE put_array — no per-entry
-        Python objects from here through the on-disk table write."""
+        Python objects from here through the on-disk table write.
+        settle=False defers all on-disk settling (the call cannot raise);
+        the caller later settles each tree at a fault-retry-safe point."""
         import numpy as np
 
         n = len(rows_u8)
@@ -131,16 +133,16 @@ class Groove:
         ts_be = np.ascontiguousarray(
             timestamps.astype(">u8")
         ).view(np.uint8).reshape(n, TS_SIZE)
-        self.objects.put_array(ts_be, rows_u8)
+        self.objects.put_array(ts_be, rows_u8, settle=settle)
         # id key: the 16 LE bytes at offset 0, reversed -> BE u128
         id_be = np.ascontiguousarray(rows_u8[:, ID_SIZE - 1 :: -1])
-        self.ids.put_array(id_be, ts_be)
+        self.ids.put_array(id_be, ts_be, settle=settle)
         for name, (off, w) in self.index_spec.items():
             field_be = rows_u8[:, off + w - 1 : (off - 1 if off else None) : -1]
             comp = np.concatenate(
                 [np.ascontiguousarray(field_be), ts_be], axis=1
             )
-            self.indexes[name].put_array(comp, b"\x00")
+            self.indexes[name].put_array(comp, b"\x00", settle=settle)
 
     def upsert(self, id_: int, timestamp: int, row: bytes,
                old_row: bytes | None = None) -> None:

@@ -215,13 +215,19 @@ class Tree:
         self._pending: list[tuple[np.ndarray, np.ndarray | bytes]] = []
         self._pending_rows = 0
         self.settle_max = 16 * memtable_max
+        # An interrupted compaction (GridBlockCorrupt mid-merge-read) must
+        # RESUME at the next settle point, before any further block
+        # allocation — otherwise a healed-and-retried replica compacts in
+        # a different order than its peers and the grids' block layouts
+        # diverge (repair-by-address depends on layout determinism).
+        self._compact_debt = False
 
     # -- writes --
 
     def put(self, key: bytes, value: bytes) -> None:
         assert len(key) == self.key_size and len(value) == self.value_size
         assert value != self.tombstone
-        if self._pending:
+        if self._pending or self._compact_debt:
             self._settle()
         self.memtable[key] = value
         if len(self.memtable) >= self.memtable_max:
@@ -234,7 +240,7 @@ class Tree:
         list or ONE shared value (secondary-index presence bytes)."""
         if not keys:
             return
-        if self._pending:
+        if self._pending or self._compact_debt:
             self._settle()
         if isinstance(values, (bytes, bytearray)):
             assert len(values) == self.value_size
@@ -260,14 +266,14 @@ class Tree:
 
     def remove(self, key: bytes) -> None:
         assert len(key) == self.key_size
-        if self._pending:
+        if self._pending or self._compact_debt:
             self._settle()
         self.memtable[key] = self.tombstone
 
     # -- reads (the lookup cascade, reference: src/lsm/tree.zig:303-433) --
 
     def get(self, key: bytes) -> bytes | None:
-        if self._pending:
+        if self._pending or self._compact_debt:
             self._settle()
         hit = self.memtable.get(key)
         if hit is not None:
@@ -290,7 +296,7 @@ class Tree:
         Newest-wins across memtable/levels; tombstones excluded (reference:
         src/lsm/tree.zig:1126-1140 RangeQuery over levels)."""
         assert len(lo) == self.key_size and len(hi) == self.key_size
-        if self._pending:
+        if self._pending or self._compact_debt:
             self._settle()
         out: dict[bytes, bytes] = {}
         # oldest-first so newer entries overwrite: deepest level first, each
@@ -392,6 +398,8 @@ class Tree:
 
     def _flush_memtable(self) -> None:
         if not self.memtable:
+            if self._compact_debt:
+                self._compact_with_debt()
             return
         items = sorted(self.memtable.items())
         self.memtable = {}
@@ -402,9 +410,20 @@ class Tree:
         info = self._write_table_arr(entries)
         self.levels[0].insert(0, info)
         self._log("i", 0, info)
-        self._maybe_compact()
+        self._compact_with_debt()
 
-    def put_array(self, keys: np.ndarray, values) -> None:
+    def _compact_with_debt(self) -> None:
+        """Run compaction under the resume contract: if a merge read
+        raises (faulted block awaiting peer repair), the debt flag stays
+        set and the NEXT settle point re-runs compaction BEFORE any new
+        allocation — so a heal-and-retry replica allocates grid blocks in
+        the same order as a replica that never faulted."""
+        self._compact_debt = True
+        self._maybe_compact()
+        self._compact_debt = False
+
+    def put_array(self, keys: np.ndarray, values,
+                  settle: bool = True) -> None:
         """Array-native bulk put: keys np.uint8 [n, key_size]; values
         np.uint8 [n, value_size] or ONE shared value (bytes) broadcast to
         every key (secondary-index presence bytes). The spill cycle's
@@ -412,7 +431,10 @@ class Tree:
 
         Arrays BUFFER in _pending and settle in bulk (one sort over many
         cycles' worth of entries, split into large tables); any read or
-        flush settles first, so visibility is unchanged."""
+        flush settles first, so visibility is unchanged. settle=False
+        defers even the size-threshold settle: the call then touches no
+        grid state at all and CANNOT raise — the exactly-once building
+        block for the spill cycle's fault-retry contract."""
         n = len(keys)
         if n == 0:
             return
@@ -421,12 +443,17 @@ class Tree:
             self._flush_memtable()
         self._pending.append((keys, values))
         self._pending_rows += n
-        if self._pending_rows >= self.settle_max:
+        if settle and self._pending_rows >= self.settle_max:
             self._settle()
 
     def _settle(self) -> None:
-        """Sort the accumulated put_array buffers into level-0 tables."""
+        """Sort the accumulated put_array buffers into level-0 tables.
+        Resume-safe: all level-0 tables land before compaction starts, so
+        a compaction raise leaves every settled entry durable in the
+        levels and sets _compact_debt for the retry."""
         if not self._pending:
+            if self._compact_debt:
+                self._compact_with_debt()
             return
         bufs, self._pending = self._pending, []
         n = self._pending_rows
@@ -455,12 +482,16 @@ class Tree:
             last[-1] = True
             last[:-1] = np.any(kw[1:] != kw[:-1], axis=1)
             entries = entries[last]
+        # ALL chunks land in level 0 before any compaction: a compaction
+        # read can raise GridBlockCorrupt (faulted block awaiting repair),
+        # and the caller's retry must find every settled entry durable in
+        # the levels — compacting between chunks would lose the rest
         for start in range(0, len(entries), self.table_entries_max):
             chunk = entries[start : start + self.table_entries_max]
             info = self._write_table_arr(chunk)
             self.levels[0].insert(0, info)
             self._log("i", 0, info)
-            self._maybe_compact()
+        self._compact_with_debt()
 
     def _log(self, op: str, level: int, info: TableInfo) -> None:
         if self.manifest_log is not None:
@@ -565,11 +596,10 @@ class Tree:
             self.levels.append([])
         src, dst = self.levels[level], self.levels[level + 1]
         if level == 0:
-            victim = src.pop()  # oldest level-0 table
+            cur = len(src) - 1  # oldest level-0 table
         else:
             cur = self._compact_cursor.get(level, 0) % len(src)
-            victim = src.pop(cur)
-            self._compact_cursor[level] = cur  # next table shifts into place
+        victim = src[cur]  # peeked, NOT popped: reads below may raise
         # intersecting run in the (sorted, disjoint) destination level
         lo_i = 0
         while lo_i < len(dst) and dst[lo_i].key_max < victim.key_min:
@@ -589,14 +619,24 @@ class Tree:
             # Ascending-key trees (object/posted trees: timestamp keys)
             # take this path almost every time, so their spill write cost
             # is one table write total.
+            src.pop(cur)
+            if level != 0:
+                self._compact_cursor[level] = cur
             self._log("r", level, victim)
             self._log("i", level + 1, victim)
             self.levels[level + 1] = dst[:lo_i] + [victim] + dst[lo_i:]
             return
 
-        new_arr = self._read_table_arr(victim)
-        parts = [new_arr] + [self._read_table_arr(i) for i in olds]
-        merged = np.concatenate(parts) if len(parts) > 1 else new_arr
+        # read EVERY merge input before touching the level lists: a read
+        # of a faulted block raises GridBlockCorrupt, the replica repairs
+        # it from a peer and retries — the tree must still hold all data.
+        # Addresses are captured at read time so the releases below never
+        # re-read (a re-read could raise AFTER the lists were mutated).
+        inputs = [self._read_table_arr(t) for t in [victim, *olds]]
+        src.pop(cur)
+        if level != 0:
+            self._compact_cursor[level] = cur  # next table shifts into place
+        merged = np.concatenate([arr for arr, _ in inputs])
         order = np.lexsort(self._key_cols(merged))
         merged = merged[order]
         n = len(merged)
@@ -619,38 +659,38 @@ class Tree:
                     merged[start : start + self.table_entries_max]
                 )
             )
-        for info in olds:
-            self.grid_release_table(info)
+        for (_, addrs), info in zip(inputs[1:], olds):
+            self._release_table(info, addrs)
             self._log("r", level + 1, info)
-        self.grid_release_table(victim)
+        self._release_table(victim, inputs[0][1])
         self._log("r", level, victim)
         for info in out:
             self._log("i", level + 1, info)
         self.levels[level + 1] = dst[:lo_i] + out + dst[hi_i:]
 
-    def _read_table_arr(self, info: TableInfo) -> np.ndarray:
+    def _read_table_arr(
+        self, info: TableInfo
+    ) -> tuple[np.ndarray, list[int]]:
         """One table's entries as a packed np.uint8 [n, entry_size] matrix
-        (the merge input form)."""
+        (the merge input form), plus its data-block addresses (so the
+        caller can release the table without re-reading the index)."""
         index = self.grid.read_block(info.index_address)
         rec = 8 + self.key_size
-        blocks = [
-            self.grid.read_block(
-                int.from_bytes(index[i * rec : i * rec + 8], "little")
-            )
+        addrs = [
+            int.from_bytes(index[i * rec : i * rec + 8], "little")
             for i in range(len(index) // rec)
         ]
-        flat = b"".join(blocks)
+        flat = b"".join(self.grid.read_block(a) for a in addrs)
         # read-only view is fine: merge inputs only flow into concatenate/
         # fancy-indexing, which allocate fresh output arrays
         return np.frombuffer(flat, dtype=np.uint8).reshape(
             -1, self.entry_size
-        )
+        ), addrs
 
-    def grid_release_table(self, info: TableInfo) -> None:
-        index = self.grid.read_block(info.index_address)
-        rec = 8 + self.key_size
-        for i in range(len(index) // rec):
-            self.grid.release(int.from_bytes(index[i * rec : i * rec + 8], "little"))
+    def _release_table(self, info: TableInfo, addrs: list[int]) -> None:
+        """Release a table's blocks from captured addresses — no reads."""
+        for a in addrs:
+            self.grid.release(a)
         self.grid.release(info.index_address)
         if info.filter_address:
             self.grid.release(info.filter_address)
@@ -675,4 +715,5 @@ class Tree:
         self.memtable = {}
         self._pending = []
         self._pending_rows = 0
+        self._compact_debt = False
         self._compact_cursor = {}

@@ -243,6 +243,26 @@ class SpillManager:
             reload_ids = self.referenced_spilled(arr)
         if reload_ids:
             self._reload_rows(reload_ids)
+        if self._io is None:
+            # sync mode: discharge the deferred settles / compaction debt
+            # HERE, after the cycle has committed (HBM rebuilt, counters
+            # updated) — a GridBlockCorrupt raise from a settle leaves the
+            # cycle done, so the replica's heal-and-retry re-enters this
+            # admit with nothing to re-cycle and the settle RESUMES
+            self._settle_forest()
+
+    def _settle_forest(self) -> None:
+        """Discharge compaction debt and settle trees whose pending
+        buffers crossed the size threshold, in the forest's fixed tree
+        order (deterministic across replicas). Thresholded, not eager:
+        settling every admit would write many tiny tables and churn the
+        grid; below-threshold pendings settle lazily at reads/flush."""
+        for tree in self.forest._trees():
+            if (
+                tree._compact_debt
+                or tree._pending_rows >= tree.settle_max
+            ):
+                tree._settle()
 
     def _fetch(self, id_: int) -> tuple[bytes, int]:
         """One spilled row + fulfill byte: the in-flight staging area
@@ -338,8 +358,16 @@ class SpillManager:
             import time as _time
 
             t0 = _time.perf_counter()
+            # sync (replica-attached) mode: settle=False — the job is a
+            # pure pending-append that CANNOT raise, so it runs exactly
+            # once even when a later settle trips GridBlockCorrupt and
+            # the replica retries the commit (admit re-drives the settle
+            # via _settle_forest, resume-safe). Async mode settles on the
+            # worker thread as usual.
+            settle = self._io is not None
             g = self.forest.transfers
-            g.insert_bulk(rows.view(np.uint8).reshape(k, 128), ts_np)
+            g.insert_bulk(rows.view(np.uint8).reshape(k, 128), ts_np,
+                          settle=settle)
             nz = np.nonzero(ful)[0]
             if len(nz):
                 self.forest.posted.put_array(
@@ -347,6 +375,7 @@ class SpillManager:
                         ts_np[nz].astype(">u8")
                     ).view(np.uint8).reshape(len(nz), 8),
                     ful[nz].astype(np.uint8).reshape(len(nz), 1),
+                    settle=settle,
                 )
             with self._staged_lock:
                 for key, tup in entries.items():
