@@ -55,6 +55,16 @@ class Grid:
         # (reference: src/vsr/superblock_free_set.zig — releases apply at
         # checkpoint, never mid-interval).
         self._staged_free: list[int] = []
+        # Block IDENTITY registry: address -> expected payload checksum of
+        # the block THIS replica wrote there. A block can carry a valid
+        # self-checksum and still be the WRONG block for its address (a
+        # peer whose layout diverged serving repair, a misdirected write) —
+        # the registry is the parent-hash the reference gets from its
+        # block-tree references (src/vsr/grid.zig block_id includes the
+        # checksum). Consulted by read/verify/install; persisted at
+        # checkpoint as a grid block chain (encode_chk_registry).
+        self.block_chk: dict[int, int] = {}
+        self._chk_chain: list[int] = []  # current registry chain blocks
 
     def _pos(self, address: int) -> int:
         assert 1 <= address <= self.block_count, address
@@ -83,12 +93,14 @@ class Grid:
 
     def write_block(self, address: int, payload: bytes) -> None:
         assert len(payload) <= BLOCK_PAYLOAD_MAX, len(payload)
+        chk = native.checksum(payload)
         head = (
-            native.checksum(payload).to_bytes(16, "little")
+            chk.to_bytes(16, "little")
             + len(payload).to_bytes(4, "little")
             + b"\x00" * 4
         )
         self.storage.write(Zone.grid, self._pos(address), head + payload)
+        self.block_chk[address] = chk
         self._cache_put(address, payload)
 
     def create_block(self, payload: bytes) -> int:
@@ -119,14 +131,21 @@ class Grid:
         payload = self.validate_raw(raw)
         if payload is None:
             raise GridBlockCorrupt(address, "bad checksum or size")
+        exp = self.block_chk.get(address)
+        if exp is not None and exp != int.from_bytes(raw[0:16], "little"):
+            # self-consistent bytes but the WRONG block for this address
+            raise GridBlockCorrupt(address, "identity mismatch")
         self._cache_put(address, payload)
         return payload
 
     def verify_block(self, address: int) -> bool:
-        """Checksum-verify a block in place (scrubbing; no cache effects).
-        True = intact."""
+        """Verify a block in place (scrubbing; no cache effects): header
+        self-checksum AND identity vs the registry. True = intact."""
         raw = self.storage.read(Zone.grid, self._pos(address), BLOCK_SIZE)
-        return self.validate_raw(raw) is not None
+        if self.validate_raw(raw) is None:
+            return False
+        exp = self.block_chk.get(address)
+        return exp is None or exp == int.from_bytes(raw[0:16], "little")
 
     def read_block_raw(self, address: int) -> bytes | None:
         """The block's verified on-disk bytes (header + payload), or None
@@ -139,10 +158,17 @@ class Grid:
         return raw[: _HEADER + size]
 
     def install_block_raw(self, address: int, raw: bytes) -> bool:
-        """Install repaired block bytes (verified) at `address`; clears the
-        cache entry so the next read sees the healed bytes."""
+        """Install repaired block bytes at `address` — verified for BOTH
+        self-consistency and identity (a diverged peer can serve bytes
+        with a valid checksum that are the wrong block for this address;
+        installing them would be silent corruption no later read could
+        catch without the registry). Clears the cache entry so the next
+        read sees the healed bytes."""
         if self.validate_raw(raw) is None:
             return False
+        exp = self.block_chk.get(address)
+        if exp is not None and exp != int.from_bytes(raw[0:16], "little"):
+            return False  # wrong-content repair: keep asking
         size = int.from_bytes(raw[16:20], "little")
         self.storage.write(Zone.grid, self._pos(address), raw[: _HEADER + size])
         self.cache.remove(address)
@@ -161,9 +187,78 @@ class Grid:
         superblock write that records it."""
         for address in self._staged_free:
             self.free_set.release(address)
+            self.block_chk.pop(address, None)
         self._staged_free.clear()
         return self.free_set.encode()
 
     def restore_free_set(self, data: bytes) -> None:
         self.free_set = FreeSet.decode(data, self.block_count)
         self._staged_free.clear()
+
+    # -- the identity-registry chain (persisted alongside the free set;
+    # the registry can exceed the superblock copy, so only the chain HEAD
+    # (address + checksum) rides the checkpoint meta — the same trailer
+    # pattern as the spill id-chain) --
+
+    _CHK_ENTRY = 24  # addr u64 + checksum u128
+
+    def encode_chk_registry(self) -> dict:
+        """Write the registry into a fresh block chain (the old chain is
+        released — staged, applied by the encode_free_set that MUST follow
+        this call) and return the verified head pointer for the meta."""
+        for address in self._chk_chain:
+            self.release(address)
+        # exclude staged frees: they leave block_chk at the encode that
+        # follows, and persisting them would make a restarted replica's
+        # registry (and therefore its chain layout and every later block
+        # allocation) diverge from a peer that never restarted
+        staged = set(self._staged_free)
+        entries = sorted(
+            (a, c) for a, c in self.block_chk.items() if a not in staged
+        )
+        per_block = (BLOCK_PAYLOAD_MAX - self._CHK_ENTRY) // self._CHK_ENTRY
+        next_addr, next_chk = 0, 0
+        chain: list[int] = []
+        if entries:
+            # written LAST chunk first so each block points at its successor
+            last = ((len(entries) - 1) // per_block) * per_block
+            for start in range(last, -1, -per_block):
+                chunk = entries[start : start + per_block]
+                payload = (
+                    next_addr.to_bytes(8, "little")
+                    + next_chk.to_bytes(16, "little")
+                    + b"".join(
+                        a.to_bytes(8, "little") + c.to_bytes(16, "little")
+                        for a, c in chunk
+                    )
+                )
+                next_addr = self.create_block(payload)
+                next_chk = self.block_chk[next_addr]
+                chain.append(next_addr)
+        self._chk_chain = chain
+        return {"addr": next_addr, "chk": f"{next_chk:x}"}
+
+    def restore_chk_registry(self, head: dict | None) -> None:
+        """Rebuild the registry by walking the chain from the verified
+        head. A missing head (legacy checkpoint) leaves the registry empty
+        — identity checks then degrade to self-checksum only."""
+        self.block_chk = {}
+        self._chk_chain = []
+        if not head or not head.get("addr"):
+            return
+        addr = int(head["addr"])
+        exp = int(head["chk"], 16)
+        while addr:
+            raw = self.storage.read(Zone.grid, self._pos(addr), BLOCK_SIZE)
+            payload = self.validate_raw(raw)
+            if payload is None or int.from_bytes(raw[0:16], "little") != exp:
+                raise GridBlockCorrupt(addr, "registry chain corrupt")
+            self._chk_chain.append(addr)
+            self.block_chk[addr] = exp
+            next_addr = int.from_bytes(payload[0:8], "little")
+            next_chk = int.from_bytes(payload[8:24], "little")
+            for i in range(24, len(payload), self._CHK_ENTRY):
+                a = int.from_bytes(payload[i : i + 8], "little")
+                c = int.from_bytes(payload[i + 8 : i + 24], "little")
+                self.block_chk[a] = c
+            addr, exp = next_addr, next_chk

@@ -282,19 +282,26 @@ class Forest:
 
     def checkpoint(self) -> dict:
         """Flush everything, persist manifest churn to the log chain, and
-        return the durable meta (manifest log blocks + free set — the
-        superblock trailer contract, reference:
-        src/vsr/superblock_manifest.zig). Block creation happens BEFORE the
-        free set encode, which applies staged releases last."""
+        return the durable meta (manifest log blocks + identity registry
+        head + free set — the superblock trailer contract, reference:
+        src/vsr/superblock_manifest.zig). Block creation (manifest chain,
+        then the registry chain capturing every live block's expected
+        checksum) happens BEFORE the free set encode, which applies staged
+        releases last."""
         self.flush()
         live = [t for tree in self._trees() for t in tree.live_tables()]
         mlog = self.manifest_log.checkpoint(live)
+        block_chk = self.grid.encode_chk_registry()
         return {
             "manifest_log": mlog,
+            "block_chk": block_chk,
             "free_set": self.grid.encode_free_set().hex(),
         }
 
     def restore(self, m: dict) -> None:
+        # the registry FIRST: every later chain/table read then carries
+        # identity verification, not just self-checksums
+        self.grid.restore_chk_registry(m.get("block_chk"))
         levels = self.manifest_log.restore(m["manifest_log"])
         for tree in self._trees():
             assert tree.tree_id > 0

@@ -155,8 +155,8 @@ class DualLedger:
             scratch._xfer_limit // 12,
             scratch._acct_limit // 2,
         )
-        if n < 1:
-            return
+        if n < 2:
+            return  # simple() needs two distinct accounts (mod n-1)
         # full wire batches pad to BATCH_PAD (the driver's steady state);
         # odd tail sizes compile on demand behind the queue
         if n == BENCH_BATCH:
