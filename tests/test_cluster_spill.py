@@ -171,3 +171,60 @@ def test_grid_block_repair_from_peers():
     # the healed replica serves commits normally and state stays identical
     _submit_transfers(cluster, client, gen, 2)
     assert_identical_state(cluster.replicas)
+
+
+def test_wrong_content_repair_refused_heals_from_honest_peer():
+    """A diverged peer serves a block whose bytes are VALID (good
+    self-checksum) but belong to a different address. The victim's
+    identity registry must refuse the install and keep asking until the
+    honest peer serves the right block; the diverged peer's own scrub
+    must then detect ITS wrong-content block (identity mismatch, not
+    checksum) and heal it back from the cluster — the silent-corruption
+    scenario address-based repair alone cannot catch."""
+    from tigerbeetle_tpu.io.storage import Zone
+    from tigerbeetle_tpu.lsm.grid import BLOCK_SIZE
+
+    cluster = Cluster(replica_count=3, grid_size=64 * 1024 * 1024,
+                      forest_blocks=192)
+    client = cluster.add_client()
+    gen = WorkloadGenerator(77, **KNOBS)
+    op, events = gen.gen_accounts_batch(60)
+    cluster.execute(client, op, types.accounts_to_np(events).tobytes())
+    _submit_transfers(cluster, client, gen, 30)
+    for r in cluster.replicas:
+        for tree in (r.forest.transfers, r.forest.posted):
+            tree.flush()
+
+    r1 = cluster.replicas[1]  # victim
+    r0 = cluster.replicas[0]  # "diverged" peer
+    grid1 = r1.forest.grid
+    acquired = [
+        a for a in range(1, grid1.block_count + 1)
+        if not grid1.free_set.is_free(a)
+    ]
+    addr, donor = acquired[0], acquired[1]
+
+    # victim: plain corruption at addr
+    cluster.storages[1].fault(Zone.grid, grid1._pos(addr) + 40, 64)
+    assert not grid1.verify_block(addr)
+    # diverged peer: ITS addr holds a valid-checksum block copied from a
+    # DIFFERENT address (layout divergence in miniature)
+    grid0 = r0.forest.grid
+    wrong = grid0.read_block_raw(donor)
+    cluster.storages[0].write(
+        Zone.grid, grid0._pos(addr), wrong
+    )
+    grid0.cache.remove(addr)
+    assert not grid0.verify_block(addr), "identity check missed the swap"
+
+    cluster.run_ticks(8 * ((grid1.block_count + 7) // 8 // 8 + 8))
+
+    # the victim healed with the RIGHT content (never the diverged bytes)
+    assert grid1.verify_block(addr), "victim not healed"
+    assert not r1._grid_missing
+    # the diverged peer's scrub found its own wrong-content block and
+    # healed it back from the cluster
+    assert grid0.verify_block(addr), "diverged peer not healed"
+
+    _submit_transfers(cluster, client, gen, 2)
+    assert_identical_state(cluster.replicas)
