@@ -705,16 +705,35 @@ def main() -> None:
 
     dispatch_us_before = round(probe_dispatch(), 1)
 
-    # throughput: K-fused dispatches in 5 equal segments, each blocked at
-    # its end — median-of-5 with per-run values (a single sample hid a 3x
-    # spread across rounds; the spread itself is now measured)
+    # throughput: K-fused dispatches in equal segments, each blocked at
+    # its end. The headline is the median over segments SELECTED by a
+    # printed dispatch-health rule: the inter-segment spread tracks the
+    # REMOTE launch path's latency, not the kernels (round-5 verdict: a
+    # 0.49 spread whose outlier segment coincided with a degraded probe),
+    # so each segment carries its own pre-segment probe and segments whose
+    # probe exceeds SEG_PROBE_FACTOR x the minimum observed probe are
+    # excluded. SEG_SPARE spare segments run so the selection can still
+    # report SEG_PLAN healthy samples; every segment commits regardless
+    # (conservation counts all groups).
+    SEG_PLAN, SEG_SPARE, SEG_PROBE_FACTOR = 5, 2, 2.0
     n_groups = max(0, (n_flag_batches - done) // K_FUSE)
-    seg_runs: list[float] = []
-    n_segs = 5 if n_groups >= 5 else 1
-    seg_size = n_groups // n_segs
+    n_total = SEG_PLAN + SEG_SPARE
+    # small-budget runs (BENCH_TRANSFERS shrunk) still get the SEG_PLAN
+    # multi-segment median — only the spares are dropped; a single
+    # segment would hide exactly the variance segmentation measures
+    if n_groups >= 4 * n_total:
+        n_segs = n_total
+    elif n_groups >= SEG_PLAN:
+        n_segs = SEG_PLAN
+    else:
+        n_segs = 1 if n_groups else 0
+    seg_size = n_groups // n_segs if n_segs else 0
+    seg_runs_all: list[float] = []
+    seg_probes: list[float] = []
     g = 0
     t_all = time.perf_counter()
     for seg in range(n_segs):
+        seg_probes.append(round(probe_dispatch(20), 1))
         take = seg_size if seg < n_segs - 1 else n_groups - seg_size * (n_segs - 1)
         t0 = time.perf_counter()
         for _ in range(take):
@@ -728,10 +747,33 @@ def main() -> None:
         jax.block_until_ready(code_max)
         dt = time.perf_counter() - t0
         if take:
-            seg_runs.append(take * K_FUSE * BATCH / dt)
+            seg_runs_all.append(take * K_FUSE * BATCH / dt)
     stages["flagship"] = time.perf_counter() - t_all
     dispatch_us_after = round(probe_dispatch(), 1)
     n_timed = n_groups * K_FUSE * BATCH
+    # -- segment selection (the printed rule) --
+    seg_rule = (
+        f"keep segments whose pre-segment dispatch probe <= "
+        f"{SEG_PROBE_FACTOR}x min(probe); first {SEG_PLAN} healthy count"
+    )
+    if seg_runs_all:
+        floor = min(seg_probes)
+        # the minimum probe satisfies its own bound, so `healthy` (and
+        # therefore `selected`) is never empty when any segment ran
+        healthy = [
+            i for i, p in enumerate(seg_probes)
+            if p <= SEG_PROBE_FACTOR * floor
+        ]
+        selected = healthy[:SEG_PLAN]
+    else:
+        selected = []
+    seg_runs = [seg_runs_all[i] for i in selected]
+    print(
+        f"flagship segment rule: {seg_rule}; probes_us={seg_probes} "
+        f"selected={selected} "
+        f"discarded={[i for i in range(len(seg_runs_all)) if i not in selected]}",
+        file=sys.stderr,
+    )
     flagship_tps = float(np.median(seg_runs)) if seg_runs else 0.0
     flagship_spread = (
         round((max(seg_runs) - min(seg_runs)) / flagship_tps, 4)
@@ -844,12 +886,19 @@ def main() -> None:
                 "metric": "create_transfers transfers/s, batch=8190, 10k "
                 "accounts (TPU commit kernel, device-generated protocol "
                 "workload, conservation+codes verified; median of "
-                f"{len(seg_runs)} segments; detail in BENCH_DETAIL.json)",
+                f"{len(seg_runs)} probe-selected segments of "
+                f"{len(seg_runs_all)} run; detail in BENCH_DETAIL.json)",
                 "value": round(flagship_tps, 1),
                 "unit": "transfers/s",
                 "vs_baseline": round(flagship_tps / BASELINE_TPS, 4),
                 "flagship_runs": [round(x, 1) for x in seg_runs],
                 "flagship_spread": flagship_spread,
+                # the selection rule is part of the artifact: the headline
+                # is reproducible only with the rule that produced it
+                "flagship_rule": seg_rule,
+                "flagship_runs_all": [round(x, 1) for x in seg_runs_all],
+                "flagship_probe_us": seg_probes,
+                "flagship_selected": selected,
                 "dispatch_us_per_launch": [
                     dispatch_us_before, dispatch_us_after
                 ],
@@ -861,6 +910,9 @@ def main() -> None:
                 "durable_shadow_verified_all": e2e.get("shadow_verified_all"),
                 "durable_device_tps": e2e.get("durable_device_tps", 0.0),
                 "group_commit_hit_rate": e2e.get("group_commit_hit_rate", 0.0),
+                "group_fuse_width": e2e.get("group_fuse_width"),
+                "shadow_upload_overlap": e2e.get("shadow_upload_overlap"),
+                "loop_us_per_batch": e2e.get("loop_us_per_batch"),
                 "spill_active_tps": configs.get("spill_active_tps", 0.0),
                 # [fresh, post-first-d2h] us/launch: the transport cliff
                 # that caps every reply-serving device path on this rig

@@ -30,9 +30,12 @@ from tigerbeetle_tpu.testing.simulator import (  # noqa: E402
 )
 
 
+VERIFY_FRACTION_DEFAULT = 0.25
+
+
 def run_seed(seed: int, ticks: int, device_fraction: float,
              fixed: bool,
-             verify_fraction: float = 0.25,
+             verify_fraction: float = VERIFY_FRACTION_DEFAULT,
              ) -> tuple[dict | None, str, str | None]:
     """(stats, topology-line, error) for one seed. A `verify_fraction`
     slice of seeds runs with the intensive online-verification tier
@@ -71,6 +74,10 @@ def main() -> int:
     ap.add_argument("--device-fraction", type=float, default=0.0,
                     help="fraction of seeds on the DeviceLedger backend "
                          "with grid faults (slow; needs jax)")
+    ap.add_argument("--verify-fraction", type=float,
+                    default=VERIFY_FRACTION_DEFAULT,
+                    help="fraction of seeds run with the intensive "
+                         "online-verification tier (constants.VERIFY)")
     ap.add_argument("--fixed", action="store_true",
                     help="legacy fixed topology (3 replicas / 2 clients)")
     ap.add_argument("--json", default=None,
@@ -82,7 +89,8 @@ def main() -> int:
     t0 = time.time()
     for seed in range(args.start, args.start + args.seeds):
         stats, desc, err = run_seed(
-            seed, args.ticks, args.device_fraction, args.fixed
+            seed, args.ticks, args.device_fraction, args.fixed,
+            verify_fraction=args.verify_fraction,
         )
         if err is None:
             print(
@@ -99,6 +107,10 @@ def main() -> int:
         if sink:
             rec = {"seed": seed, "ticks": args.ticks, "topology": desc,
                    "device_fraction": args.device_fraction,
+                   # the VERIFY-slice draw depends on verify_fraction, not
+                   # the seed alone: record it so hub replays stay
+                   # reproducible if the default ever changes
+                   "verify_fraction": args.verify_fraction,
                    "fixed": args.fixed, "ok": err is None}
             rec["error" if err else "stats"] = err or stats
             sink.write(json.dumps(rec) + "\n")
@@ -111,6 +123,8 @@ def main() -> int:
         extra = ""
         if args.device_fraction:
             extra += f" --device-fraction {args.device_fraction}"
+        if args.verify_fraction != VERIFY_FRACTION_DEFAULT:
+            extra += f" --verify-fraction {args.verify_fraction}"
         if args.fixed:
             extra += " --fixed"
         print("replay failures with: python scripts/vopr.py "

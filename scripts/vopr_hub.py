@@ -61,13 +61,16 @@ def replay(rec: dict) -> tuple[bool, str | None]:
     """Re-run one failing record's seed with the SAME mode flags the
     fleet used (recorded per seed — the topology draw depends on
     device_fraction/fixed, not the seed alone)."""
-    from scripts.vopr import run_seed
+    from scripts.vopr import VERIFY_FRACTION_DEFAULT, run_seed
 
     _, _, err = run_seed(
         rec["seed"], rec["ticks"],
         device_fraction=rec.get("device_fraction", 0.0),
         fixed=rec.get(
             "fixed", rec["topology"].startswith("fixed")
+        ),
+        verify_fraction=rec.get(
+            "verify_fraction", VERIFY_FRACTION_DEFAULT
         ),
     )
     return err is not None, err
@@ -99,6 +102,12 @@ def file_report(group: dict, out_dir: Path,
         extra = ""
         if r.get("device_fraction"):
             extra += f" --device-fraction {r['device_fraction']}"
+        vf = r.get("verify_fraction")
+        if vf is not None:
+            # always explicit: the replay must not depend on the CURRENT
+            # default matching the fleet's (the drift this field exists
+            # to prevent)
+            extra += f" --verify-fraction {vf}"
         if r.get("fixed"):
             extra += " --fixed"
         lines += [

@@ -428,6 +428,71 @@ def test_group_commit_matches_oracle():
     assert_matches_oracle(r, committed)
 
 
+def test_fuse_window_holds_short_run_then_dispatches():
+    """The group-commit fuse window: with earlier commits still in flight,
+    a SHORT quorum-ready run of create_transfers defers (so arrivals
+    within the window coalesce into one fused dispatch) and dispatches
+    once the window expires. With the engine idle it never defers — the
+    hold must not starve the engine or deadlock a quiet server."""
+    from tigerbeetle_tpu.types import TRANSFER_DTYPE
+
+    cluster = Cluster(replica_count=1)
+    r = cluster.replicas[0]
+    c1 = cluster.add_client()
+    c2 = cluster.add_client()
+    r.commit_window = 4
+    assert r.fuse_window_ns > 0  # default on
+
+    acc = np.zeros(8, dtype=types.ACCOUNT_DTYPE)
+    acc["id_lo"] = np.arange(1, 9)
+    acc["ledger"] = 1
+    acc["code"] = 1
+    c1.request(Operation.create_accounts, acc.tobytes())
+    cluster.network.run()
+    r.pump_commits()
+    r.flush_commits()
+    cluster.network.run()
+    c1.take_reply()
+
+    def xfer(base):
+        arr = np.zeros(4, dtype=TRANSFER_DTYPE)
+        arr["id_lo"] = np.arange(base, base + 4)
+        arr["debit_account_id_lo"] = 1 + np.arange(4) % 8
+        arr["credit_account_id_lo"] = 1 + (np.arange(4) + 3) % 8
+        arr["amount_lo"] = 1
+        arr["ledger"] = 1
+        arr["code"] = 1
+        return arr.tobytes()
+
+    # engine idle (_inflight empty): the first batch dispatches at once
+    base = r.commit_min
+    c1.request(Operation.create_transfers, xfer(1000))
+    cluster.network.run()
+    r.pump_commits()
+    assert r.commit_min == base + 1, "idle engine must not defer"
+    assert r._fuse_started is None
+
+    # engine busy (batch 1 un-flushed in _inflight): a short run defers
+    c2.request(Operation.create_transfers, xfer(2000))
+    cluster.network.run()
+    r.pump_commits()
+    assert r.commit_min == base + 1, "short run should hold while busy"
+    assert r._fuse_started is not None
+
+    # window expiry (one deterministic tick = 10 ms >> fuse_window_ns):
+    # the held run dispatches
+    cluster.time.tick()
+    r.pump_commits()
+    assert r.commit_min == base + 2
+    assert r._fuse_started is None
+
+    r.flush_commits()
+    cluster.network.run()
+    for c in (c1, c2):
+        _h, reply = c.take_reply()
+        assert reply == b"", reply
+
+
 def test_standby_follows_without_voting():
     """A standby (reference: src/vsr/replica.zig:163-175) journals and
     commits the replicated stream but never acks or votes: quorums are

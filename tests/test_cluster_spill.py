@@ -182,7 +182,6 @@ def test_wrong_content_repair_refused_heals_from_honest_peer():
     checksum) and heal it back from the cluster — the silent-corruption
     scenario address-based repair alone cannot catch."""
     from tigerbeetle_tpu.io.storage import Zone
-    from tigerbeetle_tpu.lsm.grid import BLOCK_SIZE
 
     cluster = Cluster(replica_count=3, grid_size=64 * 1024 * 1024,
                       forest_blocks=192)

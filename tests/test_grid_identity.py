@@ -53,6 +53,28 @@ def test_wrong_content_repair_install_rejected():
     assert g.install_block_raw(a, right_raw)
 
 
+def test_install_at_unregistered_address_gains_identity():
+    """A block healed at an address with NO registry entry (healed before
+    its first checkpoint, or a legacy restore) must gain identity coverage
+    at install — otherwise it stays self-checksum-only forever AND is
+    silently excluded from every future encode_chk_registry."""
+    g, _ = _grid()
+    a = g.create_block(b"heal me")
+    raw = g.read_block_raw(a)
+    want_chk = g.block_chk[a]
+    del g.block_chk[a]  # simulate an unregistered address
+    assert g.install_block_raw(a, raw)
+    assert g.block_chk.get(a) == want_chk, (
+        "healed block must enter the identity registry"
+    )
+    # ... and persist into the next checkpoint's registry chain
+    head = g.encode_chk_registry()
+    g.encode_free_set()
+    g2 = Grid(g.storage, offset=0, block_count=192, cache_blocks=32)
+    g2.restore_chk_registry(head)
+    assert g2.block_chk.get(a) == want_chk
+
+
 def test_registry_chain_roundtrip():
     """encode_chk_registry -> restore_chk_registry reproduces the
     registry exactly (chain blocks included), across enough entries to

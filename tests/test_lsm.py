@@ -63,6 +63,27 @@ def test_tree_put_get_flush_levels():
     assert any(tree.levels)
 
 
+def test_put_array_settle_false_rejects_nonempty_memtable():
+    """put_array(settle=False) documents 'touches no grid state, CANNOT
+    raise' — the exactly-once building block of the spill fault-retry
+    contract. A non-empty memtable would force a flush (which writes
+    tables and can raise GridBlockCorrupt), so mixing put() with
+    put_array(settle=False) must fail loudly instead of silently breaking
+    the contract."""
+    import numpy as np
+
+    _, grid = _grid()
+    tree = Tree(grid, key_size=8, value_size=8, memtable_max=64)
+    keys = np.arange(4, dtype=np.uint64).byteswap().view(np.uint8)
+    keys = keys.reshape(4, 8)
+    vals = np.ones((4, 8), dtype=np.uint8)
+    tree.put_array(keys, vals, settle=False)  # empty memtable: fine
+    tree.put((99).to_bytes(8, "big"), b"\x01" * 8)  # memtable now dirty
+    with pytest.raises(AssertionError, match="settle=False"):
+        tree.put_array(keys, vals, settle=False)
+    tree.put_array(keys, vals, settle=True)  # settle=True may flush
+
+
 def test_tree_compaction_reclaims_blocks_and_drops_tombstones():
     _, grid = _grid()
     tree = Tree(grid, key_size=8, value_size=8, memtable_max=32)

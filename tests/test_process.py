@@ -233,7 +233,9 @@ def test_c_example_client_against_live_server(server):
     exe = os.path.join(native_dir, "example_client")
     cc = subprocess.run(
         ["gcc", "-O2", "-o", exe, "example_client.c",
-         "-L.", "-ltb_native", "-Wl,-rpath," + native_dir],
+         # -lpthread explicitly: libtb_native.so uses pthreads and some
+         # toolchains do not resolve transitive shared-lib deps
+         "-L.", "-ltb_native", "-lpthread", "-Wl,-rpath," + native_dir],
         cwd=native_dir, capture_output=True, text=True,
     )
     assert cc.returncode == 0, cc.stderr

@@ -251,8 +251,18 @@ def run_e2e(
                 result["group_commit_hit_rate"] = round(
                     g.get("fused_ops", 0) / total, 4
                 )
+                if g.get("fused_groups"):
+                    result["group_fuse_width"] = round(
+                        g["fused_ops"] / g["fused_groups"], 2
+                    )
+            loop = server_stats.get("loop", {})
+            if loop:
+                result["loop_us_per_batch"] = loop.get("us_per_batch")
             if "device_shadow" in server_stats:
                 result["device_shadow"] = server_stats["device_shadow"]
+                sh = server_stats["device_shadow"].get("shadow") or {}
+                if sh.get("upload_overlap") is not None:
+                    result["shadow_upload_overlap"] = sh["upload_overlap"]
         return result
     finally:
         if proc.poll() is None:

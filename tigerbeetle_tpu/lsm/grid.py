@@ -166,11 +166,23 @@ class Grid:
         read sees the healed bytes."""
         if self.validate_raw(raw) is None:
             return False
+        chk = int.from_bytes(raw[0:16], "little")
         exp = self.block_chk.get(address)
-        if exp is not None and exp != int.from_bytes(raw[0:16], "little"):
+        if exp is not None and exp != chk:
             return False  # wrong-content repair: keep asking
         size = int.from_bytes(raw[16:20], "little")
         self.storage.write(Zone.grid, self._pos(address), raw[: _HEADER + size])
+        if exp is None:
+            # A block healed at an unregistered address gains identity
+            # coverage NOW (and persists into the next checkpoint's
+            # registry) — otherwise it would stay self-checksum-only and
+            # be excluded from every future encode_chk_registry. Tradeoff:
+            # with no registry entry there is nothing to verify content
+            # AGAINST, so this pins the first-arriving valid bytes; a
+            # diverged peer answering first wins the slot either way
+            # (the old behavior also installed them, just unregistered) —
+            # cross-replica state checks remain the backstop there.
+            self.block_chk[address] = chk
         self.cache.remove(address)
         return True
 

@@ -440,6 +440,16 @@ class Tree:
             return
         assert keys.shape == (n, self.key_size) and keys.dtype == np.uint8
         if self.memtable:
+            # settle=False promises "touches no grid state, CANNOT raise";
+            # flushing a memtable writes tables and runs compaction (both
+            # can raise GridBlockCorrupt). A caller mixing put() with
+            # put_array(settle=False) must fail loudly here rather than
+            # silently breaking the spill job's exactly-once fault-retry
+            # contract.
+            assert settle, (
+                "put_array(settle=False) requires an empty memtable: the "
+                "no-raise guarantee cannot hold across a memtable flush"
+            )
             self._flush_memtable()
         self._pending.append((keys, values))
         self._pending_rows += n

@@ -118,6 +118,17 @@ class DualLedger:
         self._chk_lock = threading.Lock()
         self._shadow_error: Exception | None = None
         self._shadow_batches = 0
+        # shadow-loop cost accounting (the h2d/staging tax shares the core
+        # with the reply-serving event loop): stage_s = host time spent
+        # staging + dispatching shadow work; idle_s = blocked on an empty
+        # queue; overlapped = groups whose staging/dispatch completed
+        # while the PREVIOUS group's kernel was still executing (the
+        # double-buffer pipeline working as intended). BENCH reports
+        # overlapped/groups as shadow_upload_overlap.
+        self.shadow_stats = {
+            "batches": 0, "groups": 0, "solo": 0,
+            "stage_s": 0.0, "idle_s": 0.0, "overlapped": 0,
+        }
         self._restored = False  # device cannot follow a snapshot restore
         self._q: queue.Queue = queue.Queue(maxsize=queue_max)
         self._thread = threading.Thread(
@@ -230,6 +241,8 @@ class DualLedger:
     # -- the device shadow ------------------------------------------------
 
     def _shadow_loop(self) -> None:
+        import time as _time
+
         import jax
         import jax.numpy as jnp
 
@@ -238,9 +251,13 @@ class DualLedger:
         fold = jax.jit(fold_reply_codes)
         chk = jnp.uint64(0)
         group_max = DeviceLedger.GROUP_KS[0]
+        stats = self.shadow_stats
+        prev_flat = None  # previous fused group's results (overlap probe)
         stop = False
         while not stop:
+            t_wait = _time.perf_counter()
             run = [self._q.get()]
+            stats["idle_s"] += _time.perf_counter() - t_wait
             if run[0] is _STOP:
                 break
             # drain a run of queued create_transfers batches: one fused
@@ -274,6 +291,7 @@ class DualLedger:
                         j += 1
                     pendings = None
                     if j - i >= 2:
+                        t_stage = _time.perf_counter()
                         pendings = self.device.try_execute_group_async(
                             [(t, a) for _, t, a in run[i:j]]
                         )
@@ -289,6 +307,15 @@ class DualLedger:
                             jnp.asarray(active),
                         )
                         self._shadow_batches += m
+                        stats["batches"] += m
+                        stats["groups"] += 1
+                        stats["stage_s"] += _time.perf_counter() - t_stage
+                        if prev_flat is not None and not prev_flat.is_ready():
+                            # this group's staging + dispatch finished
+                            # while the previous kernel was still running:
+                            # the upload pipeline overlapped execution
+                            stats["overlapped"] += 1
+                        prev_flat = g.results
                     else:
                         # fusion refused (a batch failed the fast-tier
                         # proof) or too short: run the stretch per-batch —
@@ -297,6 +324,7 @@ class DualLedger:
                         # core the event loop needs. j == i means run[i]
                         # is not create_transfers (accounts): one batch.
                         end = j if j > i else i + 1
+                        t_stage = _time.perf_counter()
                         for op2, ts2, arr2 in run[i:end]:
                             pending = self.device.execute_async(
                                 op2, ts2, arr2
@@ -305,6 +333,9 @@ class DualLedger:
                                 chk, pending.results, jnp.int32(len(arr2))
                             )
                             self._shadow_batches += 1
+                            stats["batches"] += 1
+                            stats["solo"] += 1
+                        stats["stage_s"] += _time.perf_counter() - t_stage
                         j = end
                     i = j
             except Exception as e:  # divergence surfaces at finalize
@@ -414,6 +445,19 @@ class DualLedger:
 
     # -- shutdown verification --------------------------------------------
 
+    def _shadow_report(self) -> dict:
+        """Shadow-loop cost/overlap summary for the [stats] line. The
+        upload_overlap ratio is the fraction of fused groups whose staging
+        + dispatch completed while the previous group's kernel was still
+        executing — 1.0 means the h2d path never waited on the device."""
+        s = dict(self.shadow_stats)
+        s["stage_s"] = round(s["stage_s"], 3)
+        s["idle_s"] = round(s["idle_s"], 3)
+        s["upload_overlap"] = (
+            round(s["overlapped"] / s["groups"], 4) if s["groups"] else None
+        )
+        return s
+
     def finalize(self, timeout: float = 600.0) -> dict:
         """Drain the shadow, then do the process's FIRST d2h reads: compare
         the two reply-code digests and the two state fingerprints. Returns
@@ -421,7 +465,8 @@ class DualLedger:
         self._q.put(_STOP)
         self._thread.join(timeout=timeout)
         if self._thread.is_alive():
-            return {"verified": False, "error": "shadow drain timed out"}
+            return {"verified": False, "error": "shadow drain timed out",
+                    "shadow": self._shadow_report()}
         if self._restored:
             return {
                 "verified": None,
@@ -462,6 +507,7 @@ class DualLedger:
         return {
             "verified": bool(ok),
             "shadow_batches": self._shadow_batches,
+            "shadow": self._shadow_report(),
             "code_stream_digest": {"native": chk_nat, "device": chk_dev},
             "fingerprint_native": fp_nat,
             "fingerprint_device": fp_dev,

@@ -1903,6 +1903,33 @@ class DeviceLedger(HostLedgerBase):
     # zero-count slots. Two capacities bound the padded-upload waste.
     GROUP_KS = (16, 4)
 
+    def _group_staging_slot(self, k: int, n_pad: int) -> dict:
+        """One of TWO alternating preallocated host staging buffers per
+        (k, n_pad): group N+1 packs into buffer B while buffer A's kernel
+        (group N) still runs — upload staging double-buffers against
+        device execution, and the per-group 16 MiB zeros+alloc (a measured
+        host-side tax on the core the event loop shares) disappears.
+        `used` tracks per-slot row counts so only stale tails are zeroed;
+        `fence` is the flat results of the last group dispatched from the
+        buffer (see the reuse fence at the call site)."""
+        pool = getattr(self, "_group_staging", None)
+        if pool is None:
+            pool = self._group_staging = {}
+        key = (k, n_pad)
+        entry = pool.get(key)
+        if entry is None:
+            entry = pool[key] = {"i": 0, "slots": [None, None]}
+        i = entry["i"]
+        entry["i"] = 1 - i
+        slot = entry["slots"][i]
+        if slot is None:
+            slot = entry["slots"][i] = {
+                "rows": np.zeros((k, n_pad, ROW_WORDS), dtype=np.uint32),
+                "used": np.zeros(k, dtype=np.int64),
+                "fence": None,
+            }
+        return slot
+
     def _group_stepper(self, k: int, n_pad: int):
         """Jitted fused commit of k fast-tier batch slots in ONE launch
         (group commit: the replica coalesces its pipeline the way the
@@ -1970,16 +1997,36 @@ class DeviceLedger(HostLedgerBase):
             return None
         k = next(g for g in reversed(self.GROUP_KS) if g >= len(items))
         n_pad = self._pad_for(max(len(arr) for _, arr in items))
-        rows = np.zeros((k, n_pad, ROW_WORDS), dtype=np.uint32)
+        slot = self._group_staging_slot(k, n_pad)
+        if slot["fence"] is not None:
+            # Double-buffer fence: this buffer last fed the group dispatched
+            # TWO groups ago — wait for that kernel before mutating it (on
+            # backends where device_put aliases host memory, e.g. CPU,
+            # reuse mid-flight would corrupt the in-flight rows). In steady
+            # state the fence is long retired and this is free; when the
+            # device is more than two groups behind, it is exactly the
+            # backpressure we want.
+            jax.block_until_ready(slot["fence"])
+            slot["fence"] = None
+        rows = slot["rows"]
+        used = slot["used"]
         ns = np.zeros(k, dtype=np.int32)  # padding slots: n=0 -> no-ops
         tss = np.zeros(k, dtype=np.uint64)
         for i, (ts, arr) in enumerate(items):
-            rows[i, : len(arr)] = arr.view(np.uint32).reshape(len(arr), ROW_WORDS)
-            ns[i] = len(arr)
+            na = len(arr)
+            rows[i, :na] = arr.view(np.uint32).reshape(na, ROW_WORDS)
+            if used[i] > na:
+                rows[i, na : used[i]] = 0  # zero only the stale tail
+            used[i] = na
+            ns[i] = na
             tss[i] = ts
+        for i in range(len(items), k):
+            if used[i]:
+                rows[i, : used[i]] = 0
+                used[i] = 0
         try:
             state, flat, summary = self._group_stepper(k, n_pad)(
-                self.state, jnp.asarray(rows), jnp.asarray(ns),
+                self.state, jax.device_put(rows), jnp.asarray(ns),
                 jnp.asarray(tss),
             )
         except Exception:
@@ -1992,6 +2039,7 @@ class DeviceLedger(HostLedgerBase):
                     raise
             self._group_disabled = True
             return None
+        slot["fence"] = flat  # this buffer is consumed once `flat` resolves
         self.state = state
         for _ts, arr in items:
             self.hazards.note_pending(arr)

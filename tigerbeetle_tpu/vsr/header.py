@@ -16,14 +16,23 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import struct
 
 import numpy as np
 
 from tigerbeetle_tpu import native
-from tigerbeetle_tpu.types import Operation, join_u128, split_u128
+from tigerbeetle_tpu.types import Operation
 
 HEADER_SIZE = 128
 VERSION = 0
+
+# One struct pack/unpack per (de)serialization: the numpy-record path cost
+# ~30 us per call and every message pays several (receive parse, checksum
+# verify, send serialize) — at wire rate that is real event-loop time.
+# u128 fields travel as (lo, hi) u64 pairs, same little-endian layout.
+_WIRE = struct.Struct("<10Q4I3QI4B")
+assert _WIRE.size == HEADER_SIZE
+_U64 = 0xFFFFFFFFFFFFFFFF
 
 
 class Command(enum.IntEnum):
@@ -58,6 +67,8 @@ class Command(enum.IntEnum):
     sync_client_sessions = 26
 
 
+# Vectorized view of the same layout (batch scans over header rings);
+# cross-checked against _WIRE below so the two definitions cannot drift.
 HEADER_DTYPE = np.dtype(
     [
         ("checksum_lo", "<u8"), ("checksum_hi", "<u8"),
@@ -105,28 +116,33 @@ class Header:
     # -- wire --
 
     def to_bytes(self) -> bytes:
-        row = np.zeros(1, dtype=HEADER_DTYPE)[0]
-        for f in ("checksum", "checksum_body", "parent", "client", "context"):
-            lo, hi = split_u128(getattr(self, f))
-            row[f + "_lo"], row[f + "_hi"] = lo, hi
-        for f in ("request", "cluster", "epoch", "view", "op", "commit",
-                  "timestamp", "size", "replica", "command", "operation",
-                  "version"):
-            row[f] = getattr(self, f)
-        return row.tobytes()
+        return _WIRE.pack(
+            self.checksum & _U64, self.checksum >> 64,
+            self.checksum_body & _U64, self.checksum_body >> 64,
+            self.parent & _U64, self.parent >> 64,
+            self.client & _U64, self.client >> 64,
+            self.context & _U64, self.context >> 64,
+            self.request, self.cluster, self.epoch, self.view,
+            self.op, self.commit, self.timestamp,
+            self.size, self.replica, self.command, self.operation,
+            self.version,
+        )
 
     @staticmethod
-    def from_bytes(b: bytes) -> "Header":
+    def from_bytes(b) -> "Header":
         assert len(b) == HEADER_SIZE, len(b)
-        row = np.frombuffer(b, dtype=HEADER_DTYPE)[0]
-        h = Header()
-        for f in ("checksum", "checksum_body", "parent", "client", "context"):
-            setattr(h, f, join_u128(row[f + "_lo"], row[f + "_hi"]))
-        for f in ("request", "cluster", "epoch", "view", "op", "commit",
-                  "timestamp", "size", "replica", "command", "operation",
-                  "version"):
-            setattr(h, f, int(row[f]))
-        return h
+        v = _WIRE.unpack(b)
+        return Header(
+            checksum=v[0] | (v[1] << 64),
+            checksum_body=v[2] | (v[3] << 64),
+            parent=v[4] | (v[5] << 64),
+            client=v[6] | (v[7] << 64),
+            context=v[8] | (v[9] << 64),
+            request=v[10], cluster=v[11], epoch=v[12], view=v[13],
+            op=v[14], commit=v[15], timestamp=v[16],
+            size=v[17], replica=v[18], command=v[19], operation=v[20],
+            version=v[21],
+        )
 
     # -- checksums (reference: src/vsr.zig:428-442 set/valid pattern) --
 
@@ -147,3 +163,31 @@ class Header:
 
     def valid_checksum_body(self, body: bytes) -> bool:
         return self.checksum_body == native.checksum(body)
+
+
+# _WIRE and HEADER_DTYPE define the same 128-byte layout twice (struct for
+# scalar speed, dtype for vectorized ring scans): pin them together so an
+# edit to one cannot silently drift from the other.
+_probe = np.frombuffer(
+    Header(
+        checksum=(1 << 64) | 2, checksum_body=3, parent=4, client=5,
+        context=6, request=7, cluster=8, epoch=9, view=10, op=11, commit=12,
+        timestamp=13, size=14, replica=15, command=16, operation=17,
+        version=18,
+    ).to_bytes(),
+    dtype=HEADER_DTYPE,
+)[0]
+assert (
+    (int(_probe["checksum_lo"]), int(_probe["checksum_hi"])) == (2, 1)
+    and int(_probe["checksum_body_lo"]) == 3
+    and int(_probe["context_lo"]) == 6
+    and int(_probe["request"]) == 7
+    and int(_probe["view"]) == 10
+    and int(_probe["op"]) == 11
+    and int(_probe["timestamp"]) == 13
+    and int(_probe["size"]) == 14
+    and int(_probe["replica"]) == 15
+    and int(_probe["command"]) == 16
+    and int(_probe["version"]) == 18
+), "Header _WIRE struct and HEADER_DTYPE layouts diverged"
+del _probe

@@ -168,6 +168,17 @@ class Replica:
         # idle; state-changing transitions (checkpoint, view change, state
         # sync) flush first.
         self.commit_window = 0
+        # Group-commit fuse window (ns): with commit_window > 0, a
+        # quorum-ready run of fewer than GROUP_MAX create_transfers
+        # prepares may be HELD for up to this long — but only while
+        # earlier commits are still in flight, so the engine never idles —
+        # letting requests that arrive within the window coalesce into ONE
+        # fused device dispatch per quorum run instead of a solo dispatch
+        # per pump turn (reference: the commit pipeline overlaps stages
+        # the same way, src/vsr/replica.zig:5102-5186). 0 disables the
+        # hold; commit_window == 0 (deterministic tests) never defers.
+        self.fuse_window_ns = 2_000_000
+        self._fuse_started: int | None = None
         self._inflight: deque[dict] = deque()
         # grid repair state: forest-block addresses awaiting peer repair
         # (reference: src/vsr/grid_blocks_missing.zig)
@@ -175,8 +186,9 @@ class Replica:
         self._scrub_cursor = 0
         self._wal_scrub_cursor = 1  # continuous WAL repair sweep position
         # group-commit observability (BENCH reports the hit rate): ops
-        # committed via a fused device dispatch vs per-op fallback
-        self.group_stats = {"fused_ops": 0, "solo_ops": 0}
+        # committed via a fused device dispatch vs per-op fallback, plus
+        # the group count (fused_ops / fused_groups = mean fusion width)
+        self.group_stats = {"fused_ops": 0, "solo_ops": 0, "fused_groups": 0}
         # test/simulator observation hook: called on every committed prepare
         self.commit_hook = None
         # observation hook on every reply built at finalize (hash_log:
@@ -1313,6 +1325,7 @@ class Replica:
             self.commit_checksum = h.checksum
             del self.pipeline[h.op]
         self.group_stats["fused_ops"] += len(run)
+        self.group_stats["fused_groups"] += 1
         self.flush_commits(keep=self.commit_window, only_ready=True)
         return True
 
@@ -1575,9 +1588,53 @@ class Replica:
     def pump_commits(self) -> None:
         """Event-loop hook: commit whatever reached quorum during this
         pump turn (deferred from _on_request so same-turn arrivals fuse
-        into one group dispatch)."""
-        if self.status == "normal" and self.is_primary and self.pipeline:
-            self._maybe_commit_pipeline()
+        into one group dispatch). A short quorum-ready run may additionally
+        be HELD for up to fuse_window_ns (see _fuse_hold) so that requests
+        arriving a few hundred microseconds apart still coalesce into one
+        fused dispatch — the difference between a ~0.4 and a ~0.9 group-
+        commit hit rate under concurrent session clients."""
+        if not (self.status == "normal" and self.is_primary and self.pipeline):
+            self._fuse_started = None
+            return
+        if self._fuse_hold():
+            return
+        self._maybe_commit_pipeline()
+
+    def _fuse_hold(self) -> bool:
+        """True while the fuse window is holding a short quorum-ready run
+        of create_transfers prepares open for more arrivals. Never holds
+        when the engine is idle (_inflight empty): deferral then buys no
+        fusion worth starving the engine for. The hold is bounded by
+        fuse_window_ns from the run's first deferral."""
+        if (
+            self.commit_window <= 0
+            or self.fuse_window_ns <= 0
+            or not self._inflight
+        ):
+            self._fuse_started = None
+            return False
+        run = 0
+        first = self.commit_min + 1
+        while run < self.GROUP_MAX:
+            e = self.pipeline.get(first + run)
+            if (
+                e is None
+                or len(e["oks"]) < self.quorum_replication
+                or e["header"].operation != int(Operation.create_transfers)
+            ):
+                break
+            run += 1
+        if run == 0 or run >= self.GROUP_MAX:
+            self._fuse_started = None
+            return False
+        now = self.time.monotonic()
+        if self._fuse_started is None:
+            self._fuse_started = now
+            return True
+        if now - self._fuse_started < self.fuse_window_ns:
+            return True
+        self._fuse_started = None
+        return False
 
     def commits_ready(self) -> bool:
         """True when the NEWEST in-flight commit's device results are
