@@ -140,3 +140,38 @@ def test_registry_excludes_staged_frees():
     g2.restore_chk_registry(head)
     assert g2.block_chk == live_registry
     assert keep in g2.block_chk
+
+
+def test_corrupt_registry_chain_degrades_at_restore(capsys):
+    """A latent sector error in the registry CHAIN at local startup
+    restore must not make restart unrecoverable (no peer-repair path
+    exists at restore time): restore degrades to an EMPTY registry with
+    a warning — identity checks fall back to self-checksum only — and
+    every data block stays readable. Blocks written after the degrade
+    regain registry coverage (and persist into the next chain)."""
+    g, storage = _grid()
+    addrs = [g.create_block(f"payload {i}".encode()) for i in range(40)]
+    head = g.encode_chk_registry()
+    g.encode_free_set()
+
+    # corrupt the chain HEAD block on disk
+    storage.fault(Zone.grid, (int(head["addr"]) - 1) * BLOCK_SIZE + 40, 64)
+
+    g2 = Grid(storage, offset=0, block_count=192, cache_blocks=32)
+    g2.restore_chk_registry(head)  # degrades, must NOT raise
+    assert g2.block_chk == {}
+    err = capsys.readouterr().err
+    assert "registry chain corrupt" in err
+    # self-checksum verification still guards every data block read
+    for a in addrs:
+        assert g2.read_block(a).startswith(b"payload")
+    # blocks written after the degrade regain identity coverage and
+    # persist into the next checkpoint's chain
+    g2.free_set = g.free_set  # adopt the allocation state (as restore does)
+    fresh = g2.create_block(b"post-degrade payload")
+    assert g2.block_chk.get(fresh) is not None
+    head2 = g2.encode_chk_registry()
+    g2.encode_free_set()
+    g3 = Grid(storage, offset=0, block_count=192, cache_blocks=32)
+    g3.restore_chk_registry(head2)
+    assert fresh in g3.block_chk

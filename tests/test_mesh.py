@@ -252,3 +252,89 @@ def test_sharded_load_guard(mesh):
     accounts = [Account(id=i, ledger=1, code=1) for i in range(1, 40)]
     with pytest.raises(RuntimeError, match="load-factor"):
         dev.execute_dense(Operation.create_accounts, 100, accounts)
+
+
+def test_sharded_chain_rollback_spans_shards(mesh):
+    """Directed cross-SHARD rollback (VERDICT #9 leftover): the chain's
+    accounts AND its transfer rows are placed on provably distinct shards
+    (owner-hash verified), a mid-chain failure rolls back balance updates
+    and row inserts on every shard it touched, and a follow-up batch
+    proves the rolled-back state is live (not just extract-consistent)."""
+    import numpy as np
+
+    from tigerbeetle_tpu.parallel.mesh import owner_of_ids_np
+
+    n_shards = 8
+
+    def owner(id_):
+        return int(owner_of_ids_np(
+            np.array([id_ & ((1 << 64) - 1)], dtype=np.uint64),
+            np.array([id_ >> 64], dtype=np.uint64),
+            n_shards,
+        )[0])
+
+    # three accounts on three DISTINCT shards
+    acct_ids, seen = [], set()
+    i = 1
+    while len(acct_ids) < 3:
+        if owner(i) not in seen:
+            seen.add(owner(i))
+            acct_ids.append(i)
+        i += 1
+    a1, a2, a3 = acct_ids
+    # chain transfer ids on two further distinct shards from each other
+    t_ids, seen_t = [], set()
+    i = 1000
+    while len(t_ids) < 3:
+        if owner(i) not in seen_t:
+            seen_t.add(owner(i))
+            t_ids.append(i)
+        i += 1
+    assert len(seen) == 3 and len(seen_t) == 3  # the rollback spans shards
+
+    oracle = OracleStateMachine()
+    dev = ShardedLedger(mesh, PROCESS)
+    ts = 50_000
+    accounts = [Account(id=i, ledger=1, code=1) for i in acct_ids]
+    ts += 3
+    assert oracle.execute_dense(Operation.create_accounts, ts, accounts) == \
+        dev.execute_dense(Operation.create_accounts, ts, accounts)
+
+    # linked chain across the three shards; the LAST link fails (amount=0
+    # -> exceeds budget rules per the reference's zero-amount semantics),
+    # so the two earlier APPLIED events must roll back on THEIR shards
+    transfers = [
+        Transfer(id=t_ids[0], debit_account_id=a1, credit_account_id=a2,
+                 amount=5, ledger=1, code=1, flags=1),
+        Transfer(id=t_ids[1], debit_account_id=a2, credit_account_id=a3,
+                 amount=7, ledger=1, code=1, flags=1),
+        Transfer(id=t_ids[2], debit_account_id=a3, credit_account_id=a1,
+                 amount=0, ledger=1, code=1),  # chain terminator, fails
+    ]
+    ts += 3
+    dense_o = oracle.execute_dense(Operation.create_transfers, ts, transfers)
+    dense_d = dev.execute_dense(Operation.create_transfers, ts, transfers)
+    assert dense_d == dense_o
+    assert dense_o[0] != 0 and dense_o[1] != 0, (
+        "chain members must report the rollback"
+    )
+    accounts_d, transfers_d, _ = dev.extract()
+    assert accounts_d == oracle.accounts
+    assert transfers_d == oracle.transfers
+    for t in t_ids:
+        assert t not in transfers_d  # every shard's insert rolled back
+    for a in acct_ids:  # every shard's balance update rolled back
+        assert accounts_d[a].debits_posted == 0
+        assert accounts_d[a].credits_posted == 0
+
+    # the rolled-back state is LIVE: the same ids re-submit cleanly
+    retry = [
+        Transfer(id=t_ids[0], debit_account_id=a1, credit_account_id=a2,
+                 amount=5, ledger=1, code=1),
+    ]
+    ts += 1
+    assert oracle.execute_dense(Operation.create_transfers, ts, retry) == \
+        dev.execute_dense(Operation.create_transfers, ts, retry) == [0]
+    accounts_d, transfers_d, _ = dev.extract()
+    assert accounts_d == oracle.accounts
+    assert transfers_d == oracle.transfers

@@ -223,3 +223,118 @@ def test_forced_serial_spill_parity():
     dev.mode = "serial"
     dense_d = dev.execute_dense(op, ts, events)
     assert dense_d == dense_o
+
+
+def test_spill_overlap_pipeline_smoke():
+    """The bench's overlapped spill pipeline in miniature (CI smoke for
+    spill-path regressions that otherwise surface only in the 147s
+    cfg_spill bench stage): a window of batches stays in flight, batch
+    g+1's referenced-spilled rows prefetch on the IO worker while batch g
+    commits, drains lag dispatch — with a tiny keep_frac so cycles fire
+    every few batches. Asserts the overlap machinery actually ran
+    (prefetches consumed, batched multi-lookups amortized) AND full-state
+    parity against the oracle."""
+    from tigerbeetle_tpu.types import Operation
+
+    oracle = OracleStateMachine()
+    _, forest = _forest()
+    # TEST_PROCESS geometry (kernel compiles shared with the other spill
+    # tests in this file); the tiny keep_frac + enough batches force
+    # multiple cycles within the 2048-row budget
+    dev = DeviceLedger(process=TEST_PROCESS, mode="auto", forest=forest,
+                       spill_keep_frac=0.2)
+    knobs = dict(ledgers=(1,), invalid_rate=0.0, conflict_rate=0.1,
+                 chain_rate=0.0, two_phase_rate=0.2, balancing_rate=0.0,
+                 limit_account_rate=0.0)
+    gen = WorkloadGenerator(21, **knobs)
+    ts = 1_000_000_000
+    for _ in range(2):
+        op, events = gen.gen_accounts_batch(40)
+        ts += len(events)
+        oracle.execute_dense(op, ts, events)
+        dev.execute_dense(op, ts, events)
+    from tigerbeetle_tpu import types as tb_types
+
+    batches = []
+    for _ in range(60):
+        op, events = gen.gen_transfers_batch(96)
+        batches.append((events, tb_types.transfers_to_np(events)))
+    window = []
+    oracle_dense = []
+    for g, (events, arr) in enumerate(batches):
+        ts += len(arr)
+        oracle_dense.append(oracle.execute_dense(
+            Operation.create_transfers, ts, events
+        ))
+        window.append((g, dev.execute_async(
+            Operation.create_transfers, ts, arr
+        )))
+        if g + 1 < len(batches):
+            dev.spill.prefetch_async(batches[g + 1][1])
+        while len(window) > 3:  # drains lag dispatch by the window depth
+            gi, p = window.pop(0)
+            assert dev.drain(p) == oracle_dense[gi], f"batch {gi}"
+    for gi, p in window:
+        assert dev.drain(p) == oracle_dense[gi], f"batch {gi}"
+    s = dev.spill.stats
+    assert s["cycles"] >= 2, "tiny keep_frac must cycle within 60 batches"
+    assert s["reloaded"] >= 1, "workload never exercised the reload path"
+    assert s["prefetches"] >= 1 and s["prefetched"] >= 1, (
+        "the prefetch/commit overlap path never served a reload"
+    )
+    assert s["lookup_batches"] >= 1
+    rep = dev.spill.overlap_report()
+    assert rep["spill_overlap"] is None or 0.0 <= rep["spill_overlap"] <= 1.0
+    if s["lookup_batches"]:
+        assert rep["spill_lookup_batch"] >= 1
+    # full-state parity (HBM + LSM + staged + prefetched views merged)
+    accounts, transfers, posted = dev.extract()
+    assert accounts == oracle.accounts
+    assert transfers == oracle.transfers
+    assert posted == oracle.posted
+
+
+def test_spill_deferred_io_stays_off_commit_path():
+    """The replica-attached executor (DeferredSpillIO): LSM insertion
+    queues at the commit and runs at io_pump/io_drain — the commit
+    dispatch path itself never settles trees. Same-input determinism:
+    two identical runs produce identical spilled sets and identical
+    result codes with the deferred executor."""
+    from tigerbeetle_tpu.types import Operation
+
+    def run_once():
+        _, forest = _forest()
+        dev = DeviceLedger(process=TEST_PROCESS, mode="auto", forest=forest,
+                           spill_io="deferred")
+        gen = WorkloadGenerator(23, ledgers=(1,), invalid_rate=0.0,
+                                conflict_rate=0.05, chain_rate=0.0,
+                                two_phase_rate=0.1, balancing_rate=0.0,
+                                limit_account_rate=0.0)
+        ts = 1_000_000_000
+        op, events = gen.gen_accounts_batch(40)
+        ts += len(events)
+        dev.execute_dense(op, ts, events)
+        codes = []
+        queued_after_cycle = 0
+        for b in range(40):
+            op, events = gen.gen_transfers_batch(96)
+            ts += len(events)
+            pre = dev.spill.stats["cycles"]
+            codes.append(tuple(dev.execute_dense(op, ts, events)))
+            if dev.spill.stats["cycles"] > pre:
+                # the cycle queued its LSM insertion instead of running it
+                queued_after_cycle = max(
+                    queued_after_cycle, dev.spill.io_pending()
+                )
+            if b % 8 == 7:
+                dev.spill.io_pump()  # the replica's tick-boundary pump
+        assert dev.spill.stats["cycles"] >= 1
+        assert queued_after_cycle >= 1, (
+            "deferred executor ran LSM insertion inside the commit path"
+        )
+        dev.spill.io_drain()
+        return codes, frozenset(dev.spill.spilled)
+
+    run_a = run_once()
+    run_b = run_once()
+    assert run_a == run_b, "deferred spill IO broke same-input determinism"

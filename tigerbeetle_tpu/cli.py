@@ -213,6 +213,9 @@ def cmd_start(args) -> int:
     replica = Replica(
         args.replica, len(addresses), storage, bus, RealTime(),
         cluster_cfg, process_cfg, backend_factory=backend_factory,
+        # production server, real time: spill/grid IO on a worker thread
+        # (deterministic harnesses keep the default "deferred" executor)
+        spill_io="threaded",
     )
     boot("replica constructed (device state allocated)")
     if args.aof:

@@ -174,9 +174,18 @@ class MemoryStorage(Storage):
         self._last_write: tuple[int, bytes] | None = None  # (abs, old bytes)
         self.reads = 0
         self.writes = 0
+        # Optional per-read observer (zone, offset, size) — the simulator's
+        # latency/IO-accounting injection point. Lives on the Storage seam
+        # so the layers above stay untouched: a hook that sleeps models a
+        # slow medium, a hook that records the calling context proves which
+        # loop paid for the read (reference: src/testing/storage.zig models
+        # read/write latency inside the fake, not the callers).
+        self.read_hook = None
 
     def read(self, zone: Zone, offset: int, size: int) -> bytes:
         self.reads += 1
+        if self.read_hook is not None:
+            self.read_hook(zone, offset, size)
         start = self.layout.offset(zone, offset)
         return bytes(self.data[start : start + size])
 

@@ -253,7 +253,13 @@ class Grid:
     def restore_chk_registry(self, head: dict | None) -> None:
         """Rebuild the registry by walking the chain from the verified
         head. A missing head (legacy checkpoint) leaves the registry empty
-        — identity checks then degrade to self-checksum only."""
+        — identity checks then degrade to self-checksum only. A CORRUPT
+        chain block degrades the same way (empty registry + warning)
+        instead of raising: this runs during local startup restore, where
+        no peer-repair path exists yet — one latent sector error in the
+        chain must not make restart unrecoverable. The registry is an
+        extra verification layer over the self-checksums, never the data
+        itself, so losing it costs coverage, not correctness."""
         self.block_chk = {}
         self._chk_chain = []
         if not head or not head.get("addr"):
@@ -264,7 +270,18 @@ class Grid:
             raw = self.storage.read(Zone.grid, self._pos(addr), BLOCK_SIZE)
             payload = self.validate_raw(raw)
             if payload is None or int.from_bytes(raw[0:16], "little") != exp:
-                raise GridBlockCorrupt(addr, "registry chain corrupt")
+                import sys
+
+                sys.stderr.write(
+                    f"warning: grid identity-registry chain corrupt at "
+                    f"block {addr}; restoring with an EMPTY registry — "
+                    "identity checks degrade to self-checksum only; "
+                    "blocks regain registry coverage as they are "
+                    "rewritten\n"
+                )
+                self.block_chk = {}
+                self._chk_chain = []
+                return
             self._chk_chain.append(addr)
             self.block_chk[addr] = exp
             next_addr = int.from_bytes(payload[0:8], "little")

@@ -197,6 +197,23 @@ class Groove:
         assert id_ in self.prefetched, "get() before prefetch()"
         return self.prefetched[id_]
 
+    def get_many_rows(
+        self, ids: list[int]
+    ) -> tuple[list[bytes | None], list[bytes | None]]:
+        """Batched id -> (row, ts_key) via ONE multi-point-read per tree
+        (IdTree then ObjectTree) instead of a full cascade per id — the
+        spill reload's vectorized multi-lookup (reference prefetch contract,
+        src/lsm/groove.zig:710-760). Positional: rows[i]/ts_keys[i] are
+        None when ids[i] is absent."""
+        ts_keys = self.ids.get_many([self._id_key(i) for i in ids])
+        hit_idx = [i for i, t in enumerate(ts_keys) if t is not None]
+        rows: list[bytes | None] = [None] * len(ids)
+        if hit_idx:
+            got = self.objects.get_many([ts_keys[i] for i in hit_idx])
+            for i, row in zip(hit_idx, got):
+                rows[i] = row
+        return rows, ts_keys
+
     def prefetch_clear(self) -> None:
         self.prefetched.clear()
 

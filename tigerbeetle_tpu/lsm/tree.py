@@ -114,6 +114,43 @@ def build_filter(keys, count: int) -> bytes:
     return FILTER_MAGIC + bits.tobytes()
 
 
+def filter_may_contain_many(filt: bytes, keys_u8: np.ndarray,
+                            version: int = 1) -> np.ndarray:
+    """Vectorized membership probe: one polynomial pass over the packed
+    key matrix (np.uint8 [n, key_size]) + FILTER_PROBES scattered bit
+    tests — the batch analog of filter_may_contain, amortizing the hash
+    over the whole id set (the multi-lookup path). Legacy (version-0)
+    filters fall back to the scalar blake2b probes per key."""
+    n = len(keys_u8)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    if not (version >= 1 and filt.startswith(FILTER_MAGIC)):
+        return np.array([
+            filter_may_contain(filt, k.tobytes(), version=version)
+            for k in keys_u8
+        ])
+    bits = np.frombuffer(filt, dtype=np.uint8, offset=len(FILTER_MAGIC))
+    nbits = len(bits) * 8
+    if nbits == 0:
+        return np.ones(n, dtype=bool)
+    h = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+    poly = np.uint64(_POLY)
+    for j in range(keys_u8.shape[1]):
+        h = (h ^ keys_u8[:, j].astype(np.uint64)) * poly
+    h ^= h >> np.uint64(33)
+    h1 = h * np.uint64(_MIX1)
+    h1 ^= h1 >> np.uint64(29)
+    h2 = (h * np.uint64(_MIX2)) | np.uint64(1)
+    may = np.ones(n, dtype=bool)
+    for i in range(FILTER_PROBES):
+        p = (h1 + np.uint64(i) * h2) % np.uint64(nbits)
+        may &= (
+            bits[(p >> np.uint64(3)).astype(np.int64)]
+            & (np.uint8(1) << (p & np.uint64(7)).astype(np.uint8))
+        ) != 0
+    return may
+
+
 def filter_may_contain(filt: bytes, key: bytes, version: int = 1) -> bool:
     if version >= 1 and filt.startswith(FILTER_MAGIC):
         bits = filt[len(FILTER_MAGIC):]
@@ -290,6 +327,115 @@ class Tree:
                 if hit is not None:
                     return None if hit == self.tombstone else hit
         return None
+
+    def get_many(self, keys: list[bytes]) -> list[bytes | None]:
+        """Batched point reads: one memtable pass, then each LEVEL is
+        walked once for the whole unresolved set — per-table bloom probes
+        run vectorized over the candidate batch and each index block is
+        parsed once per table per call, not once per key (the reference
+        saturates IO depth across a prefetch batch the same way,
+        src/lsm/groove.zig:710-760). Results are positional: out[i] is the
+        live value for keys[i] or None (missing or tombstone). Equivalent
+        to [self.get(k) for k in keys] by construction — the cascade
+        resolves each key at the NEWEST occurrence, same as get()."""
+        if self._pending or self._compact_debt:
+            self._settle()
+        n = len(keys)
+        out: list[bytes | None] = [None] * n
+        mt = self.memtable
+        tomb = self.tombstone
+        unresolved: set[int] = set()
+        for i, k in enumerate(keys):
+            hit = mt.get(k)
+            if hit is None:
+                unresolved.add(i)
+            elif hit != tomb:
+                out[i] = hit
+        # level 0: overlapping tables newest-first — each table claims the
+        # candidates in its key range that an older table must not shadow
+        for info in self.levels[0]:
+            if not unresolved:
+                return out
+            cand = [
+                i for i in unresolved
+                if info.key_min <= keys[i] <= info.key_max
+            ]
+            if cand:
+                self._table_get_many(info, keys, cand, out, unresolved)
+        # levels >= 1: disjoint sorted tables — group the (sorted)
+        # unresolved keys by covering table with one merge walk per level
+        for level in self.levels[1:]:
+            if not unresolved:
+                return out
+            if not level:
+                continue
+            order = sorted(unresolved, key=lambda i: keys[i])
+            t = 0
+            by_table: dict[int, list[int]] = {}
+            for i in order:
+                k = keys[i]
+                while t < len(level) and level[t].key_max < k:
+                    t += 1
+                if t == len(level):
+                    break
+                if level[t].key_min <= k:
+                    by_table.setdefault(t, []).append(i)
+            for t, cand in by_table.items():
+                self._table_get_many(level[t], keys, cand, out, unresolved)
+        return out
+
+    def _table_get_many(self, info: TableInfo, keys: list[bytes],
+                        cand: list[int], out: list,
+                        unresolved: set[int]) -> None:
+        """Resolve `cand` (indices into keys) against ONE table: vectorized
+        bloom probe over the batch, one index-block parse, then per-data-
+        block grouped binary searches. Hits (including tombstones) are
+        recorded in `out` and removed from `unresolved` — a hit at this
+        depth shadows every older occurrence."""
+        ksz = self.key_size
+        if info.filter_address:
+            keys_u8 = np.frombuffer(
+                b"".join(keys[i] for i in cand), dtype=np.uint8
+            ).reshape(len(cand), ksz)
+            may = filter_may_contain_many(
+                self.grid.read_block(info.filter_address), keys_u8,
+                version=info.filter_version,
+            )
+            cand = [i for i, m in zip(cand, may) if m]
+            if not cand:
+                return
+        index = self.grid.read_block(info.index_address)
+        rec = 8 + ksz
+        nb = len(index) // rec
+        firsts = [index[j * rec + 8 : j * rec + 8 + ksz] for j in range(nb)]
+        from bisect import bisect_right
+
+        by_block: dict[int, list[int]] = {}
+        for i in cand:
+            pos = max(0, bisect_right(firsts, keys[i]) - 1)
+            by_block.setdefault(pos, []).append(i)
+        e = self.entry_size
+        tomb = self.tombstone
+        for pos, members in by_block.items():
+            addr = int.from_bytes(index[pos * rec : pos * rec + 8], "little")
+            data = self.grid.read_block(addr)
+            ne = len(data) // e
+            for i in members:
+                key = keys[i]
+                lo, hi = 0, ne - 1
+                while lo <= hi:
+                    mid = (lo + hi) // 2
+                    k = data[mid * e : mid * e + ksz]
+                    if k == key:
+                        v = data[mid * e + ksz : (mid + 1) * e]
+                        if v != tomb:
+                            out[i] = v
+                        unresolved.discard(i)
+                        break
+                    if k < key:
+                        lo = mid + 1
+                    else:
+                        hi = mid - 1
 
     def range(self, lo: bytes, hi: bytes) -> list[tuple[bytes, bytes]]:
         """All live (key, value) pairs with lo <= key <= hi, ascending.

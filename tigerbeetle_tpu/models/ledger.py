@@ -1720,6 +1720,7 @@ class DeviceLedger(HostLedgerBase):
         forest=None,
         spill_keep_frac: float = 0.25,
         spill_async_io: bool = True,
+        spill_io=None,
     ):
         self.cluster = cluster
         self.process = process
@@ -1736,8 +1737,13 @@ class DeviceLedger(HostLedgerBase):
         if forest is not None:
             from tigerbeetle_tpu.models.spill import SpillManager
 
+            # spill_io selects the IO executor behind the spill store:
+            # None/"threaded" = real worker thread (production overlap),
+            # "deferred" = deterministic event-loop-paced queue (the VSR
+            # replica / simulator — see models/spill.py DeferredSpillIO),
+            # or an executor instance.
             self.spill = SpillManager(self, forest, keep_frac=spill_keep_frac,
-                                      async_io=spill_async_io)
+                                      async_io=spill_async_io, io=spill_io)
         # Host-tracked occupancy for the load-factor guard (1/2 max — the
         # probe-window unresolve probability is ~alpha^window, so alpha <= 1/2
         # with window 32 makes window overflow a ~2^-32 event; see

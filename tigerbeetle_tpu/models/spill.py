@@ -21,6 +21,26 @@ module closes that gap the TPU-native way:
   This is the prefetch contract: after admit(), the kernels' HBM lookups
   are equivalent to lookups against the full store.
 
+The OVERLAPPED SPILL PIPELINE (the reference saturates IO depth while the
+previous op commits, src/lsm/groove.zig:710-760; all storage IO rides one
+async loop, src/io/linux.zig:17-42):
+
+- prefetch/commit overlap: a driver that knows batch N+1 while batch N's
+  commit kernel runs calls ``prefetch_async(arr)`` — the referenced-
+  spilled id scan happens inline (cheap numpy), and the LSM point reads +
+  row staging run on the IO executor into a double-buffered host slot.
+  The admit() that later commits the batch finds the rows staged and pays
+  only the device reload launch; ``stats`` accounts how much of the gather
+  time was hidden (``t_prefetch_worker`` vs ``t_prefetch_wait``).
+- vectorized multi-lookup: cold-row fetches resolve through ONE batched
+  LSM multi-point-read per tree (lsm/tree.py Tree.get_many) — memtable and
+  each level walked once per id set, bloom probes vectorized, index blocks
+  parsed once per table per call — instead of a full per-id cascade.
+- the reload staging buffers double-buffer against device execution the
+  same way the group-commit upload slots do (models/ledger.py
+  _group_staging_slot): two alternating preallocated host buffers, each
+  fenced on the last reload dispatched from it.
+
 Accounts do not spill: account rows are the working set of every batch
 (dr/cr balance updates), and the reference's workload shape is a bounded
 account population with unbounded transfer history — the transfer table is
@@ -32,6 +52,7 @@ from __future__ import annotations
 
 import os
 import threading
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 
 import jax
@@ -55,6 +76,136 @@ ROW_WORDS = 32
 CHUNK = 8192  # static shape of gather/reload kernels (= BATCH_PAD)
 
 
+# ----------------------------------------------------------------------
+# the IO executor seam (reference: ALL storage IO rides one event loop off
+# the replica's hot path, src/io/linux.zig:17-42). Two implementations:
+#
+# - ThreadedSpillIO (production): ONE worker thread, FIFO — the insert
+#   order is deterministic, and LSM insertion/compaction truly overlaps
+#   the caller's commits in wall time.
+# - DeferredSpillIO (deterministic harnesses — the VSR replica, cluster
+#   tests, the simulator): jobs queue and run inline at pump()/drain() on
+#   the caller's thread, so seeded runs never depend on thread timing,
+#   while the commit dispatch path still never executes LSM insertion —
+#   jobs run at the event loop's tick boundary (Replica.tick pumps).
+#   Grid-block ALLOCATION order stays identical to the threaded executor's
+#   (same FIFO job order), which is what cross-replica repair-by-address
+#   depends on.
+# ----------------------------------------------------------------------
+
+
+class ThreadedSpillIO:
+    """Single-worker FIFO executor: real async IO for wall-clock overlap."""
+
+    settle_in_worker = True  # jobs may settle trees (raises surface at drain)
+
+    def __init__(self):
+        self._ex = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="spill-io"
+        )
+        self._jobs: list[Future] = []
+
+    def submit(self, fn, *args) -> Future:
+        f = self._ex.submit(fn, *args)
+        self._jobs.append(f)
+        return f
+
+    def drain(self) -> None:
+        """Barrier: wait for EVERY queued job even when an earlier one
+        raised — dropping the tail would let a healed-and-retried caller
+        read trees the worker is still mutating. The first exception
+        surfaces after the whole queue has settled."""
+        jobs, self._jobs = self._jobs, []
+        err = None
+        for f in jobs:
+            try:
+                f.result()
+            except BaseException as e:
+                if err is None:
+                    err = e
+        if err is not None:
+            raise err
+
+    def pump(self) -> None:
+        """Reap finished jobs (surfacing their exceptions) without
+        blocking on the ones still running. Finished jobs are evicted
+        BEFORE any exception propagates — a failed job must raise once,
+        not on every subsequent pump."""
+        keep, finished = [], []
+        for f in self._jobs:
+            (keep if not f.done() else finished).append(f)
+        self._jobs = keep
+        err = None
+        for f in finished:
+            try:
+                f.result()
+            except BaseException as e:
+                if err is None:
+                    err = e
+        if err is not None:
+            raise err
+
+    def wait(self, fut: Future):
+        return fut.result()
+
+    def pending(self) -> int:
+        return len(self._jobs)
+
+
+class DeferredSpillIO:
+    """Deterministic executor: jobs queue and run inline at pump()/drain()
+    — off the commit dispatch path, with zero thread timing. Jobs here
+    must be pure pending-appends (settle_in_worker=False): a
+    GridBlockCorrupt raised from a tick-boundary pump would have no
+    heal-and-retry context, so settles stay in admit's _settle_forest,
+    where the replica's repair path catches them."""
+
+    settle_in_worker = False
+
+    def __init__(self):
+        self._q: deque = deque()
+
+    def submit(self, fn, *args) -> Future:
+        f: Future = Future()
+        self._q.append((f, fn, args))
+        return f
+
+    def _run_one(self) -> None:
+        f, fn, args = self._q.popleft()
+        try:
+            r = fn(*args)
+        except BaseException as e:
+            f.set_exception(e)
+            raise
+        f.set_result(r)
+
+    def pump(self) -> None:
+        while self._q:
+            self._run_one()
+
+    drain = pump
+
+    def wait(self, fut: Future):
+        while self._q and not fut.done():
+            self._run_one()
+        return fut.result()
+
+    def pending(self) -> int:
+        return len(self._q)
+
+
+def _make_io(async_io: bool, io):
+    if os.environ.get("TB_SPILL_SYNC") == "1":
+        return None  # forced inline IO (debugging)
+    if io == "threaded":
+        return ThreadedSpillIO()
+    if io == "deferred":
+        return DeferredSpillIO()
+    if io is not None:
+        return io  # caller-provided executor instance
+    return ThreadedSpillIO() if async_io else None
+
+
 _SPILL_KERNELS_CACHE: dict = {}
 
 
@@ -74,6 +225,8 @@ class SpillKernels:
         self.t_log2 = process.transfer_slots_log2
         self.t_dump = 1 << self.t_log2
         self.ts_occ = jax.jit(self._ts_occ)
+        self.cycle_head = jax.jit(self._cycle_head)
+        self.split_idx = jax.jit(self._split_idx)
         self.gather = jax.jit(self._gather)
         self.reload = jax.jit(self._reload, donate_argnums=(0, 1, 2))
 
@@ -84,6 +237,34 @@ class SpillKernels:
             xfer_rows[:, 31].astype(U64) << jnp.uint64(32)
         )
         return ts, occ
+
+    def _cycle_head(self, xfer_rows, fault):
+        """[live count, fault]: the ONLY words the cycle fetches before
+        deciding the split — the old path shipped the full per-slot
+        (ts, occ) arrays device->host and sorted on host, a whole-table
+        d2h + sync per cycle on the degraded-transport rig."""
+        _, occ = self._ts_occ(xfer_rows)
+        live = jnp.sum(occ.astype(U32))
+        return jnp.stack([live, fault.astype(U32)])
+
+    def _split_idx(self, xfer_rows, n_cold):
+        """Device-side cold/hot partition: sort the live timestamps, take
+        the watermark at n_cold (timestamps are unique by construction, so
+        the split is exact), and emit padded index arrays the gather
+        kernels consume DIRECTLY — no host round trip. Padding lanes hold
+        t_dump (the gather sentinel row); the arrays are oversized by one
+        CHUNK so every CHUNK-window slice is full-width (one gather
+        compile)."""
+        ts, occ = self._ts_occ(xfer_rows)
+        inf = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        ts_m = jnp.where(occ, ts, inf)
+        watermark = jnp.sort(ts_m)[n_cold]
+        cold = occ & (ts_m < watermark)
+        hot = occ & ~(ts_m < watermark)
+        size = self.t_dump + CHUNK
+        cold_idx = jnp.nonzero(cold, size=size, fill_value=self.t_dump)[0]
+        hot_idx = jnp.nonzero(hot, size=size, fill_value=self.t_dump)[0]
+        return cold_idx.astype(jnp.int32), hot_idx.astype(jnp.int32)
 
     def _gather(self, xfer_rows, fulfill, idx):
         return xfer_rows[idx], fulfill[idx]
@@ -113,7 +294,13 @@ class SpillKernels:
         xfer_rows = xfer_rows.at[w].set(rows_b)
         fulfill = fulfill.at[w].set(ful_b)
         used_slots = used_slots + jnp.where(proceed, n_new, jnp.uint64(0))
-        return xfer_rows, fulfill, claim, used_slots, fault
+        # probe: a dedicated output NOTHING else consumes — the staging
+        # double-buffer fences on it (state outputs get donated by later
+        # kernels, so their buffers may be deleted before the fence fires;
+        # the xor keeps it a distinct graph node so XLA cannot alias it
+        # onto a state output's buffer)
+        probe = used_slots.astype(U32) ^ fault.astype(U32)
+        return xfer_rows, fulfill, claim, used_slots, fault, probe
 
 
 class SpillManager:
@@ -125,7 +312,7 @@ class SpillManager:
     """
 
     def __init__(self, ledger, forest, keep_frac: float = 0.25,
-                 async_io: bool = True):
+                 async_io: bool = True, io=None):
         assert 0.0 < keep_frac < 1.0
         self.ledger = ledger
         self.forest = forest
@@ -143,29 +330,34 @@ class SpillManager:
         # src/vsr/superblock.zig:31-34).
         self._id_chain: list[int] = []
         # t_* keys: cumulative seconds per cycle stage (the spill bench's
-        # isolating artifact — which part of the cycle carries the bill)
+        # isolating artifact — which part of the cycle carries the bill).
+        # Overlap accounting: t_prefetch_worker = executor seconds spent
+        # gathering prefetched rows; t_prefetch_wait = seconds admit
+        # BLOCKED on an unfinished prefetch (0 wait = the gather fully hid
+        # behind the previous batch's commit). lookup_ids/lookup_batches =
+        # multi-lookup amortization (mean ids per batched LSM read).
         self.stats = {
             "cycles": 0, "spilled": 0, "reloaded": 0,
             "t_scan": 0.0, "t_gather_d2h": 0.0, "t_stage": 0.0,
             "t_rebuild": 0.0, "t_reload": 0.0, "t_lsm_worker": 0.0,
+            "prefetches": 0, "prefetched": 0,
+            "t_prefetch_worker": 0.0, "t_prefetch_wait": 0.0,
+            "lookup_batches": 0, "lookup_ids": 0,
         }
-        # Async IO executor (reference: ALL storage IO rides one event
-        # loop off the replica's hot path, src/io/linux.zig:17-42): the
-        # spill cycle hands LSM insertion to ONE worker (FIFO = the insert
-        # order is deterministic) and commit continues as soon as the d2h
-        # gather lands. Rows in flight sit in _staged (id -> (row, ful));
-        # _fetch checks _staged first and barriers on the queue before any
-        # direct forest read. TB_SPILL_SYNC=1 forces inline IO (debugging).
-        self._io: ThreadPoolExecutor | None = (
-            None
-            if not async_io or os.environ.get("TB_SPILL_SYNC") == "1"
-            else ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="spill-io"
-            )
-        )
-        self._io_jobs: list[Future] = []
+        # the IO executor seam (see module docstring / ThreadedSpillIO vs
+        # DeferredSpillIO); None = fully inline synchronous IO
+        self._io = _make_io(async_io, io)
+        # rows in flight to the LSM sit in _staged (id -> (row, ful));
+        # fetches check _staged first and barrier on the executor before
+        # any direct forest read
         self._staged: dict[int, tuple[np.ndarray, int]] = {}
         self._staged_lock = threading.Lock()
+        # one outstanding prefetch (consumed by the next reload) + its two
+        # alternating host staging slots
+        self._prefetch: dict | None = None
+        self._pf_slots = {"i": 0, "slots": [None, None]}
+        # double-buffered reload staging (pad -> two fenced slots)
+        self._reload_slots: dict[int, dict] = {}
 
     # ------------------------------------------------------------------
     # the IO executor seam
@@ -175,16 +367,29 @@ class SpillManager:
         if self._io is None:
             fn(*args)
             return
-        self._io_jobs.append(self._io.submit(fn, *args))
+        self._io.submit(fn, *args)
 
     def io_drain(self) -> None:
         """Barrier: every queued LSM job has run (and surfaced its
         exception, if any). After this the forest is safe to read inline —
         only the commit thread submits jobs, so none can appear while the
         caller holds the drained state."""
-        jobs, self._io_jobs = self._io_jobs, []
-        for f in jobs:
-            f.result()
+        if self._io is not None:
+            self._io.drain()
+
+    def io_pump(self) -> None:
+        """Non-blocking housekeeping: run deferred jobs (DeferredSpillIO)
+        or reap finished worker jobs (ThreadedSpillIO). The replica calls
+        this at its tick boundary — LSM insertion then never runs inside
+        the commit dispatch path."""
+        if self._io is not None:
+            self._io.pump()
+
+    def io_pending(self) -> int:
+        """Queued-but-undrained job count (the replica's scrub pass skips
+        a turn while inserts are in flight rather than reading blocks the
+        worker may be mid-writing)."""
+        return 0 if self._io is None else self._io.pending()
 
     # ------------------------------------------------------------------
     # membership
@@ -223,6 +428,123 @@ class SpillManager:
         return sorted(out)
 
     # ------------------------------------------------------------------
+    # prefetch/commit overlap
+    # ------------------------------------------------------------------
+
+    @property
+    def prefetch_enabled(self) -> bool:
+        """True when prefetch_async can actually overlap (threaded
+        executor) — callers gate side work (e.g. the backup's WAL peek)
+        on this."""
+        return self._io is not None and getattr(
+            self._io, "settle_in_worker", False
+        )
+
+    def _pf_slot(self, k: int) -> dict:
+        """One of two alternating prefetch staging slots, grown to cover
+        k rows. Only one prefetch is ever outstanding and its rows are
+        copied out synchronously at consume time, so alternation alone
+        keeps a lingering job from racing a fresh submission."""
+        pool = self._pf_slots
+        i = pool["i"]
+        pool["i"] = 1 - i
+        slot = pool["slots"][i]
+        cap = _next_pow2(k)
+        if slot is None or slot["cap"] < cap:
+            slot = pool["slots"][i] = {
+                "rows": np.zeros((cap, ROW_WORDS), dtype=np.uint32),
+                "ful": np.zeros(cap, dtype=np.uint32),
+                "cap": cap,
+            }
+        return slot
+
+    def prefetch_async(self, arr: np.ndarray) -> None:
+        """Start gathering the referenced-spilled rows of an UPCOMING
+        batch on the IO executor: the id scan runs inline (cheap numpy —
+        and `spilled` mutates only on the commit thread, so the scan must
+        not move to the worker), the LSM point reads + row staging run as
+        one FIFO job behind every queued insert (so no drain barrier is
+        needed). The admit() that commits the batch consumes the staged
+        rows; content is stable meanwhile because an id's LSM row can only
+        change after a reload removes it from `spilled`, and reloads
+        happen only in admit on this same thread.
+
+        Threaded executors only: on DeferredSpillIO the job would run
+        inline on this same thread (no overlap to win), and its
+        read-triggered settle could raise GridBlockCorrupt at the tick
+        pump — outside the admit context where the replica's
+        heal-and-retry contract lives."""
+        if not self.prefetch_enabled or not self.spilled:
+            return
+        pf = self._prefetch
+        if pf is not None and not pf["fut"].done():
+            return  # one outstanding prefetch; don't pile up slot reuse
+        ids = self.referenced_spilled(arr)
+        if not ids:
+            return
+        slot = self._pf_slot(len(ids))
+        fut = self._io.submit(self._prefetch_job, ids, slot)
+        self._prefetch = {
+            "fut": fut,
+            "rows": slot["rows"],
+            "ful": slot["ful"],
+            "by_id": {id_: j for j, id_ in enumerate(ids)},
+        }
+        self.stats["prefetches"] += 1
+
+    def _prefetch_job(self, ids: list[int], slot: dict) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        rows, ful = slot["rows"], slot["ful"]
+        missing: list[tuple[int, int]] = []
+        with self._staged_lock:
+            for j, id_ in enumerate(ids):
+                hit = self._staged.get(id_)
+                if hit is not None:
+                    rows[j] = hit[0]
+                    ful[j] = hit[1]
+                else:
+                    missing.append((j, id_))
+        if missing:
+            # FIFO position guarantees every earlier insert already landed
+            self._fetch_forest(missing, rows, ful)
+        self.stats["t_prefetch_worker"] += _time.perf_counter() - t0
+
+    def _consume_prefetch(self, ids, rows: np.ndarray,
+                          ful: np.ndarray) -> list[tuple[int, int]]:
+        """Fill rows/ful lanes served by the outstanding prefetch; returns
+        the (lane, id) pairs it did not cover. Consumed once on any hit;
+        a COMPLETE miss keeps it armed for a later batch (a driver may
+        prefetch op N+1 before op N's own reload runs) — sound because a
+        kept entry's id is still in `spilled` (only a reload that served
+        it would have removed it), and an id's backing content is stable
+        while spilled (see prefetch_async)."""
+        import time as _time
+
+        pf = self._prefetch
+        if pf is None:
+            return list(enumerate(ids))
+        by_id = pf["by_id"]
+        if not any(id_ in by_id for id_ in ids):
+            return list(enumerate(ids))  # foreign batch: keep it armed
+        self._prefetch = None
+        t0 = _time.perf_counter()
+        self._io.wait(pf["fut"])  # pump-aware (DeferredSpillIO runs inline)
+        self.stats["t_prefetch_wait"] += _time.perf_counter() - t0
+        prows, pful = pf["rows"], pf["ful"]
+        remaining: list[tuple[int, int]] = []
+        for i, id_ in enumerate(ids):
+            j = by_id.get(id_)
+            if j is None:
+                remaining.append((i, id_))
+            else:
+                rows[i] = prows[j]
+                ful[i] = pful[j]
+                self.stats["prefetched"] += 1
+        return remaining
+
+    # ------------------------------------------------------------------
     # admission: called before every create_transfers commit
     # ------------------------------------------------------------------
 
@@ -243,12 +565,13 @@ class SpillManager:
             reload_ids = self.referenced_spilled(arr)
         if reload_ids:
             self._reload_rows(reload_ids)
-        if self._io is None:
-            # sync mode: discharge the deferred settles / compaction debt
-            # HERE, after the cycle has committed (HBM rebuilt, counters
-            # updated) — a GridBlockCorrupt raise from a settle leaves the
-            # cycle done, so the replica's heal-and-retry re-enters this
-            # admit with nothing to re-cycle and the settle RESUMES
+        if self._io is None or not self._io.settle_in_worker:
+            # sync/deferred mode: discharge the deferred settles /
+            # compaction debt HERE, after the cycle has committed (HBM
+            # rebuilt, counters updated) — a GridBlockCorrupt raise from a
+            # settle leaves the cycle done, so the replica's heal-and-retry
+            # re-enters this admit with nothing to re-cycle and the settle
+            # RESUMES
             self._settle_forest()
 
     def _settle_forest(self) -> None:
@@ -281,13 +604,38 @@ class SpillManager:
         ful = self.forest.posted.get(ts_key)
         return row, (ful[0] if ful else 0)
 
+    def _fetch_forest(self, missing: list[tuple[int, int]],
+                      rows: np.ndarray, ful: np.ndarray) -> None:
+        """Resolve (lane, id) pairs against the forest with ONE vectorized
+        multi-point-read per tree (IdTree -> ObjectTree -> posted) — the
+        bloom/index amortization lives in Tree.get_many. Caller guarantees
+        the forest is current (drained, or running ON the FIFO worker)."""
+        g = self.forest.transfers
+        ids_list = [id_ for _, id_ in missing]
+        row_list, ts_keys = g.get_many_rows(ids_list)
+        fuls = self.forest.posted.get_many(
+            [t if t is not None else b"\x00" * 8 for t in ts_keys]
+        )
+        for (i, id_), row, tsk, f in zip(missing, row_list, ts_keys, fuls):
+            assert tsk is not None and row is not None, (
+                f"spilled id {id_} missing from LSM"
+            )
+            rows[i] = np.frombuffer(row, dtype=np.uint32)
+            ful[i] = f[0] if f else 0
+        self.stats["lookup_batches"] += 1
+        self.stats["lookup_ids"] += len(missing)
+
     def _fetch_many(self, ids: list[int], rows: np.ndarray,
                     ful: np.ndarray) -> None:
-        """Fill rows[:k]/ful[:k] for `ids`: staged hits copied without a
-        barrier, the rest read from the forest after ONE io_drain."""
+        """Fill rows[:k]/ful[:k] for `ids`: prefetched rows first (no IO),
+        then staged hits (no barrier), then ONE batched forest read after
+        ONE io_drain."""
+        remaining = self._consume_prefetch(ids, rows, ful)
+        if not remaining:
+            return
         missing: list[tuple[int, int]] = []
         with self._staged_lock:
-            for i, id_ in enumerate(ids):
+            for i, id_ in remaining:
                 hit = self._staged.get(id_)
                 if hit is not None:
                     rows[i] = hit[0]
@@ -297,15 +645,34 @@ class SpillManager:
         if not missing:
             return
         self.io_drain()
-        g = self.forest.transfers
-        for i, id_ in missing:
-            ts_key = g.ids.get(g._id_key(id_))
-            assert ts_key is not None, f"spilled id {id_} missing from LSM"
-            row = g.objects.get(ts_key)
-            assert row is not None
-            rows[i] = np.frombuffer(row, dtype=np.uint32)
-            f = self.forest.posted.get(ts_key)
-            ful[i] = f[0] if f else 0
+        self._fetch_forest(missing, rows, ful)
+
+    def _reload_slot(self, pad: int) -> dict:
+        """One of TWO alternating preallocated reload staging buffers per
+        pad (the PR-1 _group_staging_slot pattern): batch N+1's rows stage
+        into buffer B while buffer A's reload kernel (batch N) may still
+        run. `fence` is the device result of the last reload dispatched
+        from the buffer — on backends where jnp.asarray aliases host
+        memory, mutating the buffer before that kernel retires would
+        corrupt the in-flight rows. `used` bounds the stale-tail zeroing."""
+        pool = self._reload_slots
+        entry = pool.get(pad)
+        if entry is None:
+            entry = pool[pad] = {"i": 0, "slots": [None, None]}
+        i = entry["i"]
+        entry["i"] = 1 - i
+        slot = entry["slots"][i]
+        if slot is None:
+            slot = entry["slots"][i] = {
+                "rows": np.zeros((pad, ROW_WORDS), dtype=np.uint32),
+                "ful": np.zeros(pad, dtype=np.uint32),
+                "used": 0,
+                "fence": None,
+            }
+        if slot["fence"] is not None:
+            jax.block_until_ready(slot["fence"])
+            slot["fence"] = None
+        return slot
 
     def _reload_rows(self, ids: list[int]) -> None:
         import time as _time
@@ -317,19 +684,24 @@ class SpillManager:
             chunk = ids[start : start + CHUNK]
             k = len(chunk)
             pad = CHUNK if len(ids) > CHUNK else _next_pow2(k)
-            rows = np.zeros((pad, ROW_WORDS), dtype=np.uint32)
-            ful = np.zeros(pad, dtype=np.uint32)
+            slot = self._reload_slot(pad)
+            rows, ful = slot["rows"], slot["ful"]
+            if slot["used"] > k:  # zero only the stale tail
+                rows[k : slot["used"]] = 0
+                ful[k : slot["used"]] = 0
+            slot["used"] = k
             self._fetch_many(chunk, rows, ful)
             active = np.zeros(pad, dtype=bool)
             active[:k] = True
             (
                 st["xfer_rows"], st["fulfill"], st["xfer_claim"],
-                st["xfer_used_slots"], st["fault"],
+                st["xfer_used_slots"], st["fault"], probe,
             ) = self.kernels.reload(
                 st["xfer_rows"], st["fulfill"], st["xfer_claim"],
                 st["xfer_used_slots"], st["fault"],
                 jnp.asarray(rows), jnp.asarray(ful), jnp.asarray(active),
             )
+            slot["fence"] = probe
             for id_ in chunk:
                 self.spilled.discard(id_)
             led._xfer_used += k
@@ -358,16 +730,19 @@ class SpillManager:
             import time as _time
 
             t0 = _time.perf_counter()
-            # sync (replica-attached) mode: settle=False — the job is a
-            # pure pending-append that CANNOT raise, so it runs exactly
-            # once even when a later settle trips GridBlockCorrupt and
-            # the replica retries the commit (admit re-drives the settle
-            # via _settle_forest, resume-safe). Async mode settles on the
-            # worker thread as usual.
-            settle = self._io is not None
+            # APPEND-THEN-SETTLE, always: the appends (settle=False) are
+            # pure pending-appends that CANNOT raise, so every row and
+            # fulfillment lands — and unstages — exactly once even when
+            # the settle below trips GridBlockCorrupt. A raise then only
+            # interrupts settling/compaction, which is resume-safe by the
+            # _pending/_compact_debt contract (the next settle — a later
+            # job, admit's _settle_forest, or the checkpoint flush —
+            # resumes it); the old settle-inside-append ordering lost the
+            # chunk's posted flags + unstage when a threaded worker raised
+            # mid-insert and the tick pump routed the error to repair.
             g = self.forest.transfers
             g.insert_bulk(rows.view(np.uint8).reshape(k, 128), ts_np,
-                          settle=settle)
+                          settle=False)
             nz = np.nonzero(ful)[0]
             if len(nz):
                 self.forest.posted.put_array(
@@ -375,7 +750,7 @@ class SpillManager:
                         ts_np[nz].astype(">u8")
                     ).view(np.uint8).reshape(len(nz), 8),
                     ful[nz].astype(np.uint8).reshape(len(nz), 1),
-                    settle=settle,
+                    settle=False,
                 )
             with self._staged_lock:
                 for key, tup in entries.items():
@@ -384,6 +759,10 @@ class SpillManager:
             # worker-thread seconds (accumulated under the stats lock's
             # coarse protection — a float add race would only smear stats)
             self.stats["t_lsm_worker"] += _time.perf_counter() - t0
+            if self._io is not None and self._io.settle_in_worker:
+                # threaded mode settles on the worker; sync/deferred mode
+                # leaves it to admit's _settle_forest (heal-retry context)
+                self._settle_forest()
 
         self._io_submit(job)
 
@@ -395,37 +774,31 @@ class SpillManager:
         """Spill the cold majority to the LSM forest and rebuild the HBM
         table with the hot tail, guaranteeing room for `need` new rows.
         A host-paced maintenance op (the analog of the reference's paced
-        compaction beats trading throughput for bounded memory)."""
+        compaction beats trading throughput for bounded memory). The scan
+        and cold/hot split run ON DEVICE (SpillKernels.cycle_head /
+        split_idx): the host fetches two words, not the whole table."""
         import time as _time
 
         led = self.ledger
         st = led.state
         t0 = _time.perf_counter()
-        fault = int(np.asarray(st["fault"]))
+        head = np.asarray(self.kernels.cycle_head(st["xfer_rows"], st["fault"]))
+        live, fault = int(head[0]), int(head[1])
         if fault:
             raise_on_fault(fault, "spill cycle")
-        ts, occ = self.kernels.ts_occ(st["xfer_rows"])
-        ts = np.asarray(ts)
-        occ = np.asarray(occ)
-        live = int(occ.sum())
         if led._xfer_limit - need < 0:
             raise RuntimeError(
                 f"batch needs {need} transfer slots but the table limit is "
                 f"{led._xfer_limit}: grow ConfigProcess.transfer_slots_log2"
             )
         keep = min(int(live * self.keep_frac), led._xfer_limit - need)
-        ts_live = np.sort(ts[occ])  # timestamps are unique by construction
         n_cold = live - keep
         if n_cold <= 0:
             return  # nothing live to spill
-        # first KEPT timestamp (keep == 0: spill everything)
-        watermark = (
-            int(ts_live[n_cold]) if n_cold < live else int(ts_live[-1]) + 1
+        cold_idx, hot_idx = self.kernels.split_idx(
+            st["xfer_rows"], jnp.int32(n_cold)
         )
-        cold = occ & (ts < watermark)
-        hot = occ & (ts >= watermark)
-        cold_idx = np.nonzero(cold)[0].astype(np.int32)
-        hot_idx = np.nonzero(hot)[0].astype(np.int32)
+        n_hot = live - n_cold
         self.stats["t_scan"] += _time.perf_counter() - t0
         t0 = _time.perf_counter()
 
@@ -436,24 +809,23 @@ class SpillManager:
         # (reference keeps all storage IO off the replica's hot path,
         # src/io/linux.zig:17-42).
         gathered = []
-        for start in range(0, len(cold_idx), CHUNK):
-            idx = cold_idx[start : start + CHUNK]
-            idx_pad = np.full(CHUNK, self.kernels.t_dump, dtype=np.int32)
-            idx_pad[: len(idx)] = idx
+        for start in range(0, n_cold, CHUNK):
+            k = min(CHUNK, n_cold - start)
             rows_d, ful_d = self.kernels.gather(
-                st["xfer_rows"], st["fulfill"], jnp.asarray(idx_pad)
+                st["xfer_rows"], st["fulfill"],
+                cold_idx[start : start + CHUNK],
             )
             for buf in (rows_d, ful_d):
                 try:
                     buf.copy_to_host_async()
                 except (AttributeError, RuntimeError):
                     pass
-            gathered.append((idx, rows_d, ful_d))
-        for idx, rows_d, ful_d in gathered:
+            gathered.append((k, rows_d, ful_d))
+        for k, rows_d, ful_d in gathered:
             # ascontiguousarray: some backends (axon) hand back arrays the
             # later .view(uint8) reinterpretation rejects
-            rows = np.ascontiguousarray(np.asarray(rows_d)[: len(idx)])
-            ful = np.ascontiguousarray(np.asarray(ful_d)[: len(idx)])
+            rows = np.ascontiguousarray(np.asarray(rows_d)[:k])
+            ful = np.ascontiguousarray(np.asarray(ful_d)[:k])
             self.stats["t_gather_d2h"] += _time.perf_counter() - t0
             t0 = _time.perf_counter()
             ids_lo = rows[:, 0].astype(np.uint64) | (
@@ -470,7 +842,7 @@ class SpillManager:
                 (int(lo) | (int(hi) << 64))
                 for lo, hi in zip(ids_lo, ids_hi)
             )
-            self.stats["spilled"] += len(idx)
+            self.stats["spilled"] += k
             self.stats["t_stage"] += _time.perf_counter() - t0
             t0 = _time.perf_counter()
 
@@ -482,16 +854,15 @@ class SpillManager:
         new_claim = jnp.full(cap1, ht.CLAIM_FREE, dtype=U32)
         new_used = jnp.uint64(0)
         new_fault = jnp.uint32(0)
-        for start in range(0, len(hot_idx), CHUNK):
-            idx = hot_idx[start : start + CHUNK]
-            idx_pad = np.full(CHUNK, self.kernels.t_dump, dtype=np.int32)
-            idx_pad[: len(idx)] = idx
+        for start in range(0, n_hot, CHUNK):
+            k = min(CHUNK, n_hot - start)
             rows_d, ful_d = self.kernels.gather(
-                st["xfer_rows"], st["fulfill"], jnp.asarray(idx_pad)
+                st["xfer_rows"], st["fulfill"],
+                hot_idx[start : start + CHUNK],
             )
             active = np.zeros(CHUNK, dtype=bool)
-            active[: len(idx)] = True
-            new_rows, new_ful, new_claim, new_used, new_fault = (
+            active[:k] = True
+            new_rows, new_ful, new_claim, new_used, new_fault, _ = (
                 self.kernels.reload(
                     new_rows, new_ful, new_claim, new_used, new_fault,
                     rows_d, ful_d, jnp.asarray(active),
@@ -504,7 +875,7 @@ class SpillManager:
         st["fulfill"] = new_ful
         st["xfer_claim"] = new_claim
         st["xfer_used_slots"] = new_used
-        led._xfer_used = len(hot_idx)
+        led._xfer_used = n_hot
         led._occupancy_epoch += 1
         self._lo = np.sort(
             np.array([x & ((1 << 64) - 1) for x in self.spilled], dtype=np.uint64)
@@ -578,6 +949,7 @@ class SpillManager:
         self.io_drain()
         with self._staged_lock:
             self._staged.clear()
+        self._prefetch = None  # gathered against the pre-restore store
         self.forest.restore(meta["manifest"])
         self._id_chain = list(meta["spilled_blocks"])
         self.spilled = set()
@@ -589,6 +961,23 @@ class SpillManager:
         self._lo = np.sort(
             np.array([x & ((1 << 64) - 1) for x in self.spilled], dtype=np.uint64)
         )
+
+    def overlap_report(self) -> dict:
+        """The bench's overlap-accounting artifact (analogous to PR 1's
+        shadow_upload_overlap): spill_overlap = fraction of prefetch-
+        gather seconds hidden behind commits (1.0 = admit never waited);
+        spill_lookup_batch = mean ids per batched LSM multi-lookup."""
+        s = self.stats
+        worker = s["t_prefetch_worker"]
+        overlap = (
+            round(max(0.0, 1.0 - s["t_prefetch_wait"] / worker), 4)
+            if worker > 0 else None
+        )
+        batch = (
+            round(s["lookup_ids"] / s["lookup_batches"], 1)
+            if s["lookup_batches"] else None
+        )
+        return {"spill_overlap": overlap, "spill_lookup_batch": batch}
 
 
 def _next_pow2(n: int, floor: int = 8) -> int:

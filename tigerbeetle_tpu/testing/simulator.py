@@ -111,6 +111,7 @@ class Simulator:
         replies_fault_probability: float = 0.1,
         superblock_fault_probability: float = 0.1,
         grid_fault_probability: float = 0.0,
+        grid_read_latency_s: float = 0.0,
         forest_blocks: int = 0,
         grid_size: int = 8 * 1024 * 1024,
         options: PacketSimulatorOptions | None = None,
@@ -159,9 +160,29 @@ class Simulator:
         self.histories: list[dict[int, tuple]] = [
             {} for _ in range(self.total_replicas)
         ]
+        # Injected grid-read latency (through the Storage seam, reference:
+        # src/testing/storage.zig read_latency): every forest-block read
+        # costs real wall time. Replica behavior keys off VIRTUAL time
+        # (ticks / the Time seam) and the spill IO rides the deterministic
+        # executor, so a seeded run's committed history must be BYTE-
+        # IDENTICAL with and without the latency — the proof that replica
+        # spill/grid IO is off the hot loop rather than hidden in it.
+        self.grid_read_latency_s = grid_read_latency_s
+        self.grid_reads = 0
+
+        def _grid_latency_hook(zone, offset, size):
+            if zone is Zone.grid and offset >= self.layout.forest_offset:
+                self.grid_reads += 1
+                if self.grid_read_latency_s > 0.0:
+                    import time as _time
+
+                    _time.sleep(self.grid_read_latency_s)
+
         for i in range(self.total_replicas):
             storage = MemoryStorage(self.layout, seed=seed * 97 + i)
             format_data_file(storage, cluster)
+            if forest_blocks:
+                storage.read_hook = _grid_latency_hook
             self.storages.append(storage)
             self.replicas.append(self._make_replica(i))
         self.down: dict[int, int] = {}  # replica -> restart tick
@@ -438,6 +459,7 @@ class Simulator:
             "replies_faults": self.replies_faults,
             "superblock_faults": self.superblock_faults,
             "grid_faults": self.grid_faults,
+            "grid_reads": self.grid_reads,
             "net": dict(self.net.stats),
             "view": self.replicas[0].view,
         }

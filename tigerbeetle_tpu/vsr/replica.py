@@ -87,6 +87,7 @@ class Replica:
         mode: str = "auto",
         backend_factory=None,
         standby_count: int = 0,
+        spill_io: str = "deferred",
     ):
         self.replica = replica_index
         self.replica_count = replica_count
@@ -117,13 +118,20 @@ class Replica:
                     offset=storage.layout.forest_offset,
                     block_count=storage.layout.forest_blocks,
                 ), memtable_max=getattr(process, "lsm_memtable_max", 2048))
-            # spill_async_io=False: the replica itself reads/writes the
-            # grid (scrub, peer repair, state sync) on its event loop —
-            # a concurrent spill-IO worker would race those accesses, and
-            # seeded simulator runs must not depend on thread timing
+            # The replica's spill/grid IO rides the SpillManager executor
+            # seam instead of running inline in the commit path:
+            # "deferred" (default) queues LSM insertion and runs it at the
+            # tick boundary (models/spill.py DeferredSpillIO) — the commit
+            # dispatch never executes LSM work, grid allocation order stays
+            # the FIFO job order (deterministic across replicas, which
+            # repair-by-address depends on), and seeded simulator runs
+            # never depend on thread timing. "threaded" (production
+            # servers, real time) moves the same jobs to a worker thread
+            # for wall-clock overlap; the scrub pass skips a turn while
+            # worker inserts are in flight (_scrub_grid).
             backend = DeviceLedger(cluster, process, mode=mode,
                                    forest=self.forest,
-                                   spill_async_io=False)
+                                   spill_io=spill_io)
         if hasattr(backend, "prefetch_results"):
             # the replica drains results to serve replies: start copies at
             # dispatch (a fetch-free driver like the flagship bench must
@@ -360,6 +368,19 @@ class Replica:
 
     def tick(self) -> None:
         self.ticks += 1
+        spill = getattr(self.ledger, "spill", None)
+        if spill is not None:
+            # run deferred LSM insert jobs (or reap finished worker jobs)
+            # at the tick boundary — never inside the commit dispatch path
+            try:
+                spill.io_pump()
+            except GridBlockCorrupt as e:
+                # a threaded worker's settle hit a corrupt block: route it
+                # to peer repair instead of crashing the event loop (the
+                # staged rows keep serving fetches; the tree's compaction
+                # debt resumes at the next settle once healed)
+                if not self._request_block_repair([e.address]):
+                    raise
         self.pump_commits()  # deferred group commits (event-loop safety)
         # finalize whatever results have LANDED (never block the tick on
         # in-flight device compute; the idle-loop flush and the next ticks
@@ -857,6 +878,12 @@ class Replica:
     def _on_block(self, header: Header, body: bytes) -> None:
         if self.forest is None or header.op not in self._grid_missing:
             return
+        spill = getattr(self.ledger, "spill", None)
+        if spill is not None and spill.io_pending():
+            # a threaded worker may be mid-settle on grid state (a freed
+            # address can be re-acquired mid-install): defer — the block
+            # stays in _grid_missing and the tick-cadence retry re-requests
+            return
         grid = self.forest.grid
         # A late duplicate reply must not overwrite an address that has
         # healed and since been released + reused — the stale bytes carry
@@ -878,6 +905,13 @@ class Replica:
         """Verify a few acquired forest blocks per pass, round-robin
         (the reference's grid scrubber): corruption below the WAL is found
         and repaired from peers BEFORE a commit needs the block."""
+        spill = getattr(self.ledger, "spill", None)
+        if spill is not None and spill.io_pending():
+            # inserts in flight: a threaded worker may be mid-write on a
+            # freshly acquired block — verifying it now would misreport
+            # corruption (deferred mode: the tick pump already emptied the
+            # queue, so this never skips there)
+            return
         grid = self.forest.grid
         checked = scanned = 0
         a = self._scrub_cursor
@@ -966,6 +1000,12 @@ class Replica:
         state = self.superblock.state
         if state is None or state.commit_min == 0:
             return None
+        spill = getattr(self.ledger, "spill", None)
+        if spill is not None:
+            # the image reads the forest block area: queued spill inserts
+            # must land first (drained HERE, on the event loop — the side
+            # thread must not touch the executor's job list)
+            spill.io_drain()
         cached = getattr(self, "_sync_payload_cache", None)
         if cached is not None and cached[0] == state.sequence:
             self._sync_payload_tick = self.ticks
@@ -1248,6 +1288,24 @@ class Replica:
     # runs into fixed-capacity scan kernels — see DeviceLedger.GROUP_KS).
     GROUP_MAX = 16
 
+    def _spill_prefetch_body(self, header: Header, body: bytes) -> None:
+        """Prefetch/commit overlap (models/spill.py): while op N's commit
+        kernel runs, the spill IO executor gathers op N+1's referenced-
+        spilled rows so its admit() finds them staged. Gated on an active
+        spilled set — otherwise this is a free no-op per commit."""
+        spill = getattr(self.ledger, "spill", None)
+        if (
+            spill is None
+            or not spill.spilled
+            or header.operation != int(Operation.create_transfers)
+        ):
+            return
+        import numpy as np
+
+        from tigerbeetle_tpu.types import TRANSFER_DTYPE
+
+        spill.prefetch_async(np.frombuffer(body, dtype=TRANSFER_DTYPE))
+
     def _maybe_commit_pipeline(self) -> None:
         committed = False
         while True:
@@ -1284,6 +1342,11 @@ class Replica:
             self.commit_checksum = header.checksum
             del self.pipeline[op]
             committed = True
+            # op's admit has run: start gathering op+1's spilled rows on
+            # the IO executor while op's commit kernel executes
+            nxt = self.pipeline.get(op + 1)
+            if nxt is not None and len(nxt["oks"]) >= self.quorum_replication:
+                self._spill_prefetch_body(nxt["header"], nxt["body"])
         if committed:
             # commit heartbeat so backups commit promptly (also sent on a
             # tick cadence)
@@ -1399,6 +1462,19 @@ class Replica:
             self.commit_min = op
             self.commit_checksum = header.checksum
             self.pipeline.pop(op, None)  # prune if it was pipelined
+            # backup-side prefetch/commit overlap: peek the next journaled
+            # prepare (gated on a threaded executor + an active spilled
+            # set — the read costs a WAL slot fetch, worthless when the
+            # prefetch would no-op)
+            spill = getattr(self.ledger, "spill", None)
+            if (
+                spill is not None and spill.spilled
+                and spill.prefetch_enabled
+                and self.commit_min < self.commit_max
+            ):
+                got2 = self.journal.read_prepare(op + 1)
+                if got2 is not None:
+                    self._spill_prefetch_body(got2[0], got2[1])
 
     def _commit_prepare(self, header: Header, body: bytes) -> bytes | None:
         """Execute one prepare against the replicated state (identical on
