@@ -52,6 +52,17 @@ import time
 
 import numpy as np
 
+from tigerbeetle_tpu.metrics import Metrics
+from tigerbeetle_tpu.tracer import NULL_TRACER, JsonTracer
+
+# The bench's shared observability pair (tigerbeetle_tpu/metrics.py):
+# every phase reports into METRICS (stage spans, batch-latency histogram,
+# the instrumented spill pipeline), and `--trace <path>` swaps TRACER for
+# a JsonTracer whose dump — merged with the e2e server's span dump — is
+# one Perfetto-loadable file covering driver AND server.
+METRICS = Metrics()
+TRACER = NULL_TRACER
+
 BASELINE_TPS = 10_000_000.0  # BASELINE.json north-star target
 N_ACCOUNTS = 10_000
 BATCH = 8190  # (1 MiB - 128 B) / 128 B, reference: src/constants.zig:167-168
@@ -382,6 +393,10 @@ def _bench_spill_config(stage, out, rng) -> None:
         process = ConfigProcess(account_slots_log2=16,
                                 transfer_slots_log2=16)  # 32k-row budget
         ledger = DeviceLedger(process=process, mode="auto", forest=forest)
+        # shared registry: spill_overlap / spill_lookup_batch below are
+        # read back out of METRICS (overlap_report reads the registry-
+        # backed StatGroup), and --trace records the prefetch/admit spans
+        ledger.instrument(METRICS, TRACER)
         ledger.pad_to = BATCH_PAD
         ts2 = 1 << 41
         next_id = 1
@@ -526,17 +541,24 @@ def _bench_spill_config(stage, out, rng) -> None:
         )
 
 
-def _median_e2e(stage, name: str, n_runs: int, log, **kw) -> dict:
+def _median_e2e(stage, name: str, n_runs: int, log, trace: bool = False,
+                **kw) -> dict:
     """run_e2e N times (fresh server each), report the median with per-run
     values + spread (round-4 verdict: single samples hid a 30%+ swing).
-    Dual-mode runs must ALL verify their device shadow."""
+    Dual-mode runs must ALL verify their device shadow. With trace=True
+    the FIRST run's server dumps its commit-pipeline spans; they ride out
+    as `trace_events` for the driver to merge into the --trace file."""
     from tigerbeetle_tpu.benchmark import run_e2e
 
     dual = "+" in kw.get("backend", "native")
     runs, shadows, last = [], [], None
+    trace_events = None
     for i in range(n_runs):
+        kw_i = dict(kw, trace="server") if (trace and i == 0) else kw
         with stage(f"{name}_{i}"):
-            last = run_e2e(log=log, **kw)
+            last = run_e2e(log=log, **kw_i)
+        if trace and i == 0:
+            trace_events = last.pop("trace_events", None)
         runs.append(last["durable_tps"])
         if dual:
             # a run whose server died before printing [stats] has no
@@ -554,10 +576,12 @@ def _median_e2e(stage, name: str, n_runs: int, log, **kw) -> dict:
     )
     if dual:
         out["shadow_verified_all"] = all(v is True for v in shadows)
+    if trace_events is not None:
+        out["trace_events"] = trace_events
     return out
 
 
-def bench_e2e(stage) -> dict:
+def bench_e2e(stage, trace: bool = False) -> dict:
     """The durable, through-consensus numbers: format a data file, start a
     REAL replica process (WAL on), drive create_transfers through TCP
     session clients at batch=8190 and verify conservation over the wire —
@@ -588,7 +612,7 @@ def bench_e2e(stage) -> dict:
     driver = os.environ.get("BENCH_E2E_DRIVER", "async")
     try:
         out = _median_e2e(
-            stage, "e2e_durable", n_runs, log,
+            stage, "e2e_durable", n_runs, log, trace=trace,
             n_accounts=N_ACCOUNTS, n_transfers=n, clients=clients,
             backend="native+device", driver=driver,
         )
@@ -631,21 +655,40 @@ def bench_e2e(stage) -> dict:
     return out
 
 
+def _parse_trace_arg(argv) -> str | None:
+    """`--trace <path>` / `--trace=<path>`: dump a merged Chrome
+    trace-event JSON (driver spans + the first e2e server's spans) there."""
+    it = iter(argv)
+    trace = None
+    for a in it:
+        if a == "--trace":
+            trace = next(it, None)
+        elif a.startswith("--trace="):
+            trace = a.split("=", 1)[1]
+    return trace
+
+
 def main() -> None:
+    global TRACER
+    trace_path = _parse_trace_arg(sys.argv[1:])
+    if trace_path:
+        TRACER = JsonTracer(metrics=METRICS)
     stages: dict[str, float] = {}
 
     def stage(name):
         class _T:
             def __enter__(self):
                 self.t0 = time.perf_counter()
+                self.tok = TRACER.start(f"bench.{name}")
 
             def __exit__(self, *a):
+                TRACER.stop(self.tok)
                 stages[name] = time.perf_counter() - self.t0
 
         return _T()
 
     # E2E first: host-only in this process (subprocess server owns the TPU)
-    e2e = bench_e2e(stage)
+    e2e = bench_e2e(stage, trace=bool(trace_path))
 
     import jax
     import jax.numpy as jnp
@@ -887,6 +930,14 @@ def main() -> None:
         )
         ledger.check_fault()
 
+    # batch-latency histogram: the registry's snapshot is the quoted
+    # artifact (same store the server/spill stats live in)
+    h_lat = METRICS.histogram("bench.batch_latency_us")
+    for ms in lat_ms:
+        h_lat.observe(ms * 1000.0)
+    lat_hist = h_lat.snapshot()
+    print(f"batch latency histogram (us): {lat_hist}", file=sys.stderr)
+
     lat = np.percentile(lat_ms if lat_ms else [float("nan")], [0, 25, 50, 75, 100])
     print(
         "stage times (s): "
@@ -903,6 +954,7 @@ def main() -> None:
     # the artifact recorded "parsed": null). Full detail — per-run durable
     # metrics, server stats, tracked configs — goes to BENCH_DETAIL.json
     # next to this script plus stderr.
+    server_trace_events = e2e.pop("trace_events", None)
     detail = {"durable": e2e, "configs": configs, "stages_s": {
         k: round(v, 2) for k, v in stages.items()
     }}
@@ -911,6 +963,18 @@ def main() -> None:
     with open(detail_path, "w") as f:
         json.dump(detail, f, indent=1)
     print("detail: " + json.dumps(detail), file=sys.stderr)
+    if trace_path:
+        # ONE Perfetto-loadable file: driver spans (pid 0) + the traced
+        # e2e server's commit-pipeline spans (pid 1 — fuse holds, journal
+        # writes, commit dispatch/finalize, shadow uploads)
+        events = TRACER.events_ordered()
+        for e in server_trace_events or []:
+            events.append(dict(e, pid=1))
+        with open(trace_path, "w") as f:
+            json.dump({"traceEvents": events}, f, sort_keys=True,
+                      separators=(",", ":"))
+        print(f"trace: {len(events)} events -> {trace_path}",
+              file=sys.stderr)
     print(
         json.dumps(
             {
@@ -934,6 +998,8 @@ def main() -> None:
                     dispatch_us_before, dispatch_us_after
                 ],
                 "latency_ms_p00_p25_p50_p75_p100": [round(x, 2) for x in lat],
+                # registry-sourced histogram snapshot (metrics.py buckets)
+                "latency_hist_us": lat_hist,
                 "ingest_tps": round(ingest_tps, 1),
                 "durable_tps": e2e.get("durable_tps", 0.0),
                 "durable_spread": e2e.get("durable_spread"),

@@ -36,6 +36,7 @@ VERIFY_FRACTION_DEFAULT = 0.25
 def run_seed(seed: int, ticks: int, device_fraction: float,
              fixed: bool,
              verify_fraction: float = VERIFY_FRACTION_DEFAULT,
+             trace_path: str | None = None,
              ) -> tuple[dict | None, str, str | None]:
     """(stats, topology-line, error) for one seed. A `verify_fraction`
     slice of seeds runs with the intensive online-verification tier
@@ -53,6 +54,11 @@ def run_seed(seed: int, ticks: int, device_fraction: float,
         verify = (seed * 2654435761 % 100) < verify_fraction * 100
         desc = describe_options(opts) + (" VERIFY" if verify else "")
     kw = {"ticks": ticks, **opts}
+    if trace_path is not None:
+        # deterministic tick-stamped trace (tracer.SimTracer): the same
+        # seed dumps byte-identical files, so two replays of a diverging
+        # seed can be diffed span by span
+        kw["trace_path"] = trace_path
     prev, constants.VERIFY = constants.VERIFY, verify or constants.VERIFY
     try:
         return run_simulation(seed, **kw), desc, None
@@ -82,6 +88,10 @@ def main() -> int:
                     help="legacy fixed topology (3 replicas / 2 clients)")
     ap.add_argument("--json", default=None,
                     help="append one JSON record per seed (vopr_hub input)")
+    ap.add_argument("--trace", default=None,
+                    help="dump a deterministic tick-stamped Chrome trace "
+                         "per seed to PATH.<seed>.json (byte-identical "
+                         "across replays of the same seed — diffable)")
     args = ap.parse_args()
 
     failures = []
@@ -91,6 +101,9 @@ def main() -> int:
         stats, desc, err = run_seed(
             seed, args.ticks, args.device_fraction, args.fixed,
             verify_fraction=args.verify_fraction,
+            trace_path=(
+                f"{args.trace}.{seed}.json" if args.trace else None
+            ),
         )
         if err is None:
             print(

@@ -143,6 +143,7 @@ def run_e2e(
     backend: str = "native",
     workload: str = "simple",
     driver: str = "python",
+    trace: str | None = None,
     log=None,
 ) -> dict:
     """Format, start a real replica, drive the protocol, return metrics.
@@ -181,13 +182,19 @@ def run_e2e(
     # and skew later timings. The server also carries a parent-death
     # watchdog (cli._install_parent_death_watchdog) for the paths where
     # this harness itself is SIGKILLed.
+    # --trace: the server dumps its commit-pipeline spans (fuse hold,
+    # journal writes, commit dispatch/finalize, shadow uploads) as Chrome
+    # trace events on SIGTERM; run_e2e loads them back so the bench can
+    # merge them into one Perfetto-loadable file.
+    server_trace = os.path.join(tmpdir, "server_trace.json") if trace else None
+    trace_args = ("--trace", server_trace) if server_trace else ()
     proc = subprocess.Popen(
         [sys.executable, "-m", "tigerbeetle_tpu", "start",
          "--addresses", f"127.0.0.1:{port}",
          "--account-slots-log2", str(acct_log2),
          "--transfer-slots-log2", str(slots_log2),
          "--backend", backend,
-         *server_args, path],
+         *trace_args, *server_args, path],
         cwd=REPO, env=env, start_new_session=True,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
@@ -258,11 +265,24 @@ def run_e2e(
             loop = server_stats.get("loop", {})
             if loop:
                 result["loop_us_per_batch"] = loop.get("us_per_batch")
+            if "metrics" in server_stats:
+                # the server's full registry snapshot (counters + timing
+                # histogram percentiles) — sourced from the same store as
+                # the loop/group numbers above
+                result["server_metrics"] = server_stats["metrics"]
             if "device_shadow" in server_stats:
                 result["device_shadow"] = server_stats["device_shadow"]
                 sh = server_stats["device_shadow"].get("shadow") or {}
                 if sh.get("upload_overlap") is not None:
                     result["shadow_upload_overlap"] = sh["upload_overlap"]
+        if server_trace and os.path.exists(server_trace):
+            import json as _json
+
+            try:
+                with open(server_trace) as f:
+                    result["trace_events"] = _json.load(f)["traceEvents"]
+            except (ValueError, KeyError, OSError):
+                pass  # a torn dump must not sink the run's numbers
         return result
     finally:
         if proc.poll() is None:

@@ -47,7 +47,10 @@ class StartArgs:
     account_slots_log2: int = 20
     transfer_slots_log2: int = 24
     aof: str = ""  # append-only disaster-recovery log path
-    statsd: str = ""  # statsd host:port
+    statsd: str = ""  # statsd host | :port | host:port (batched emission)
+    # dump a Chrome trace-event JSON (Perfetto-loadable) of the commit
+    # pipeline's spans to this path on shutdown (SIGTERM)
+    trace: str = ""
     commit_window: int = 16  # async commits in flight (0 = sync); a full
     # GROUP_MAX fused group stays un-drained while the next one arrives
     # Group-commit fuse window in MICROSECONDS (0 disables): a short
@@ -155,8 +158,17 @@ def cmd_start(args) -> int:
     from tigerbeetle_tpu.constants import ConfigCluster, ConfigProcess
     from tigerbeetle_tpu.io.message_bus import TCPMessageBus
     from tigerbeetle_tpu.io.time import RealTime
-    from tigerbeetle_tpu.statsd import StatsD
+    from tigerbeetle_tpu.metrics import Metrics
+    from tigerbeetle_tpu.statsd import StatsD, StatsDEmitter, parse_addr
+    from tigerbeetle_tpu.tracer import JsonTracer, Tracer
     from tigerbeetle_tpu.vsr.replica import Replica
+
+    # ONE registry + tracer for the whole process: the replica, bus,
+    # journal, ledger and spill pipeline all report here, and the [stats]
+    # line / --statsd emission / --trace dump read from it (the reference
+    # wires tracer.zig + statsd.zig through the same stages).
+    metrics = Metrics()
+    tracer = JsonTracer(metrics=metrics) if args.trace else Tracer()
 
     addresses = _parse_addresses(args.addresses)
     cluster_cfg = ConfigCluster(replica_count=len(addresses))
@@ -168,6 +180,8 @@ def cmd_start(args) -> int:
     storage = _storage(args.file, cluster_cfg, create=False, grid_mb=args.grid_mb)
     boot("storage open")
     bus = TCPMessageBus(addresses, args.replica, listen=True)
+    bus.metrics = metrics
+    bus.tracer = tracer
     boot("bus bound")  # must not contain "listening": spawners match on it
     backend_factory = None
     if args.backend == "native":
@@ -216,16 +230,22 @@ def cmd_start(args) -> int:
         # production server, real time: spill/grid IO on a worker thread
         # (deterministic harnesses keep the default "deferred" executor)
         spill_io="threaded",
+        metrics=metrics,
+        tracer=tracer,
     )
     boot("replica constructed (device state allocated)")
     if args.aof:
         replica.aof = AOF(args.aof)
     replica.commit_window = args.commit_window
     replica.fuse_window_ns = args.fuse_window_us * 1000
-    statsd = None
+    statsd = emitter = None
     if args.statsd:
-        host, _, port = args.statsd.rpartition(":")
-        statsd = StatsD(host or "127.0.0.1", int(port))
+        # accepts `host`, `:port`, and `host:port` (a bare host used to
+        # crash on int("") after rpartition)
+        statsd = StatsD(*parse_addr(args.statsd))
+        # batched emission: the WHOLE registry per flush, many metrics
+        # per MTU-sized datagram, counters as deltas
+        emitter = StatsDEmitter(statsd, metrics)
     boot("opening (superblock + snapshot + WAL recovery)")
     replica.open()
     boot("open done")
@@ -248,8 +268,9 @@ def cmd_start(args) -> int:
     # flush, never blocking selects or idle sleeps) over ops committed BY
     # THIS PROCESS (commit_min starts at the recovered commit number on
     # restart) — the per-batch loop cost the bench reports as
-    # loop_us_per_batch
-    loop_stats = {"busy_s": 0.0, "turns": 0}
+    # loop_us_per_batch. Registry-backed: the [stats] line and --statsd
+    # read the same counters.
+    loop_stats = metrics.group("loop", ("busy_s", "turns"))
     boot_commit = replica.commit_min
 
     def _on_term(_sig, _frm):
@@ -259,7 +280,7 @@ def cmd_start(args) -> int:
 
         hz = getattr(replica.ledger, "hazards", None)
         stats = {
-            "group": replica.group_stats,
+            "group": dict(replica.group_stats),
             "split": dict(hz.split_stats) if hz is not None else {},
             "pool_dropped": bus.pool.dropped,
             "loop": {
@@ -270,6 +291,10 @@ def cmd_start(args) -> int:
                     / max(1, replica.commit_min - boot_commit), 1
                 ),
             },
+            # the full registry (counters/gauges/histogram percentile
+            # snapshots): the bench harness and --statsd read the SAME
+            # store this line is printed from
+            "metrics": metrics.snapshot(),
         }
         if getattr(replica.ledger, "spill", None) is not None:
             stats["spill"] = dict(replica.ledger.spill.stats)
@@ -288,6 +313,10 @@ def cmd_start(args) -> int:
                     "error": f"{type(e).__name__}: {e}",
                 }
         print(f"[stats] {_json.dumps(stats)}", flush=True)
+        if args.trace:
+            tracer.dump(args.trace)
+        if emitter is not None:
+            emitter.flush()  # final batched emission before exit
         if prof is not None:
             prof.disable()
             prof.dump_stats(profile_path)
@@ -301,6 +330,7 @@ def cmd_start(args) -> int:
     tick_s = process_cfg.tick_ms / 1000.0
     last_tick = time.monotonic()
     last_debug = time.monotonic()
+    last_statsd = time.monotonic()
     last_commit = replica.commit_min
     while True:
         # With async commits in flight — or a fuse window holding a short
@@ -314,8 +344,8 @@ def cmd_start(args) -> int:
         # group, and an expired fuse window must dispatch promptly
         replica.pump_commits()
         if busy:
-            loop_stats["busy_s"] += time.monotonic() - t0
-            loop_stats["turns"] += 1
+            loop_stats.add("busy_s", time.monotonic() - t0)
+            loop_stats.add("turns")
         if n == 0 and busy:
             # Bus idle: flush once the whole window's device results are
             # computed — ONE device->host round trip then drains every
@@ -324,17 +354,27 @@ def cmd_start(args) -> int:
             if replica.commits_ready():
                 t0 = time.monotonic()
                 replica.flush_commits()
-                loop_stats["busy_s"] += time.monotonic() - t0
+                loop_stats.add("busy_s", time.monotonic() - t0)
             elif replica._inflight:
                 time.sleep(0.0002)
         now = time.monotonic()
         if now - last_tick >= tick_s:
             last_tick = now
             replica.tick()
-            if statsd is not None and replica.commit_min != last_commit:
-                statsd.count("ops_committed", replica.commit_min - last_commit)
-                statsd.gauge("commit_min", replica.commit_min)
+            # registry updates are unconditional — the [stats] snapshot
+            # and bench server_metrics carry them with or without statsd
+            if replica.commit_min != last_commit:
+                metrics.counter("server.ops_committed").add(
+                    replica.commit_min - last_commit
+                )
+                metrics.gauge("server.commit_min").set(replica.commit_min)
                 last_commit = replica.commit_min
+            # batched flush on a ~1s cadence: the WHOLE registry rides a
+            # handful of MTU-sized datagrams instead of one packet per
+            # metric per tick
+            if emitter is not None and now - last_statsd >= 1.0:
+                last_statsd = now
+                emitter.flush()
         if debug and now - last_debug >= 1.0:
             last_debug = now
             print(

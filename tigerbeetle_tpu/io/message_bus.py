@@ -21,8 +21,11 @@ from __future__ import annotations
 import errno
 import selectors
 import socket
+import time as _time
 
 from tigerbeetle_tpu.io.network import Address, Handler, Network
+from tigerbeetle_tpu.metrics import NULL_METRICS
+from tigerbeetle_tpu.tracer import NULL_TRACER
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Header
 
 MESSAGE_SIZE_MAX_DEFAULT = 1 << 20
@@ -67,6 +70,11 @@ class _Conn:
 
 
 class TCPMessageBus(Network):
+    # observability seams (re-pointed by the composition root; defaults
+    # are the zero-cost no-op backends)
+    metrics = NULL_METRICS
+    tracer = NULL_TRACER
+
     def __init__(
         self,
         addresses: list[tuple[str, int]],
@@ -135,8 +143,12 @@ class TCPMessageBus(Network):
         per turn). pump() calls this on entry (so bytes queued between
         pumps never wait out a blocking select) and on exit (so sends
         queued by this turn's handlers leave with it)."""
-        for conn in list(self.conns.values()):
-            if conn.wbuf:
+        pending = [c for c in self.conns.values() if c.wbuf]
+        if not pending:
+            return
+        self.metrics.counter("bus.flushes").add()
+        with self.tracer.span("bus.flush", conns=len(pending)):
+            for conn in pending:
                 self._flush(conn)
 
     # -- connections --
@@ -212,6 +224,7 @@ class TCPMessageBus(Network):
                 return
             del conn.wbuf[:n]
             self.pool.credit(n)
+            self.metrics.counter("bus.tx_bytes").add(n)
 
     # -- pumping --
 
@@ -219,6 +232,7 @@ class TCPMessageBus(Network):
         """One event-loop turn: accept/read/dispatch. Returns frames
         dispatched."""
         dispatched = 0
+        t0 = _time.perf_counter_ns() if self.metrics.enabled else 0
         self.flush_pending()  # deferred sends must not wait out the select
         for key, mask in self.sel.select(timeout):
             kind, conn = key.data
@@ -262,6 +276,13 @@ class TCPMessageBus(Network):
             if closing:
                 self._close(conn)
         self.flush_pending()  # this turn's handler sends leave with it
+        if dispatched and t0:
+            # only turns that dispatched frames: idle selects would bury
+            # the signal (and cost a histogram write per quiet turn)
+            self.metrics.counter("bus.frames").add(dispatched)
+            self.metrics.histogram("bus.pump_us").observe(
+                (_time.perf_counter_ns() - t0) / 1000.0
+            )
         return dispatched
 
     # byte offset of the header's size u32: five u128s (80) + four u32s
@@ -271,6 +292,14 @@ class TCPMessageBus(Network):
     def _drain(self, conn: _Conn) -> int:
         n = 0
         buf = conn.rbuf
+        # frame-parse span: only when there is at least one parseable
+        # frame AND tracing is on (pump calls _drain for every readable
+        # conn; empty passes must stay free)
+        tok = (
+            self.tracer.start("bus.frame_parse")
+            if self.tracer.enabled and len(buf) - conn.roff >= HEADER_SIZE
+            else 0
+        )
         mv = memoryview(buf)
         try:
             while len(buf) - conn.roff >= HEADER_SIZE:
@@ -312,6 +341,8 @@ class TCPMessageBus(Network):
                     n += 1
         finally:
             mv.release()
+            if tok:
+                self.tracer.stop(tok)
         # compact ONCE per turn (a del per frame moved the whole tail —
         # O(bytes) per 1 MiB batch frame — on every message)
         if conn.roff:

@@ -16,6 +16,7 @@ from __future__ import annotations
 from tigerbeetle_tpu import native
 from tigerbeetle_tpu.io.storage import Storage, Zone
 from tigerbeetle_tpu.lsm.cache import SetAssociativeCache
+from tigerbeetle_tpu.metrics import NULL_METRICS
 from tigerbeetle_tpu.vsr.free_set import FreeSet
 
 BLOCK_SIZE = 128 * 1024  # reference: src/config.zig:140
@@ -35,6 +36,9 @@ class GridBlockCorrupt(RuntimeError):
 
 
 class Grid:
+    # observability seam (re-pointed by SpillManager.instrument / bench)
+    metrics = NULL_METRICS
+
     def __init__(self, storage: Storage, offset: int, block_count: int,
                  cache_blocks: int = 256):
         """`offset`: byte offset within the grid zone where the block area
@@ -128,12 +132,15 @@ class Grid:
         if cached is not None:
             return cached
         raw = self.storage.read(Zone.grid, self._pos(address), BLOCK_SIZE)
+        self.metrics.counter("grid.block_reads").add()
         payload = self.validate_raw(raw)
         if payload is None:
+            self.metrics.counter("grid.corrupt_blocks").add()
             raise GridBlockCorrupt(address, "bad checksum or size")
         exp = self.block_chk.get(address)
         if exp is not None and exp != int.from_bytes(raw[0:16], "little"):
             # self-consistent bytes but the WRONG block for this address
+            self.metrics.counter("grid.corrupt_blocks").add()
             raise GridBlockCorrupt(address, "identity mismatch")
         self._cache_put(address, payload)
         return payload

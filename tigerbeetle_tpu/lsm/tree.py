@@ -33,6 +33,8 @@ import hashlib
 import numpy as np
 
 from tigerbeetle_tpu.lsm.grid import BLOCK_PAYLOAD_MAX, Grid
+from tigerbeetle_tpu.metrics import NULL_METRICS
+from tigerbeetle_tpu.tracer import NULL_TRACER
 
 GROWTH_FACTOR = 8  # reference: src/config.zig:142
 LEVEL0_TABLES_MAX = 4
@@ -223,6 +225,11 @@ def _bisect_table(level: list[TableInfo], key: bytes) -> int | None:
 
 
 class Tree:
+    # observability seams (SpillManager.instrument / the bench re-point
+    # these at the shared registry; defaults cost nothing)
+    metrics = NULL_METRICS
+    tracer = NULL_TRACER
+
     def __init__(self, grid: Grid, key_size: int, value_size: int,
                  memtable_max: int = 4096, manifest_log=None,
                  tree_id: int = 0, filters: bool = True):
@@ -340,6 +347,14 @@ class Tree:
         resolves each key at the NEWEST occurrence, same as get()."""
         if self._pending or self._compact_debt:
             self._settle()
+        with self.tracer.span("lsm.get_many", ids=len(keys)), \
+                self.metrics.histogram("lsm.get_many_us").time():
+            out = self._get_many(keys)
+        self.metrics.counter("lsm.lookup_batches").add()
+        self.metrics.counter("lsm.lookup_ids").add(len(keys))
+        return out
+
+    def _get_many(self, keys: list[bytes]) -> list[bytes | None]:
         n = len(keys)
         out: list[bytes | None] = [None] * n
         mt = self.memtable
@@ -401,7 +416,12 @@ class Tree:
                 self.grid.read_block(info.filter_address), keys_u8,
                 version=info.filter_version,
             )
+            n_probed = len(cand)
             cand = [i for i, m in zip(cand, may) if m]
+            self.metrics.counter("lsm.bloom_probes").add(n_probed)
+            self.metrics.counter("lsm.bloom_negatives").add(
+                n_probed - len(cand)
+            )
             if not cand:
                 return
         index = self.grid.read_block(info.index_address)
@@ -603,6 +623,11 @@ class Tree:
             self._settle()
 
     def _settle(self) -> None:
+        with self.tracer.span("lsm.compact", rows=self._pending_rows), \
+                self.metrics.histogram("lsm.compact_us").time():
+            self._settle_inner()
+
+    def _settle_inner(self) -> None:
         """Sort the accumulated put_array buffers into level-0 tables.
         Resume-safe: all level-0 tables land before compaction starts, so
         a compaction raise leaves every settled entry durable in the

@@ -39,7 +39,9 @@ import threading
 import numpy as np
 
 from tigerbeetle_tpu.constants import ConfigProcess
+from tigerbeetle_tpu.metrics import Metrics
 from tigerbeetle_tpu.models.native_ledger import NativeLedger
+from tigerbeetle_tpu.tracer import NULL_TRACER
 from tigerbeetle_tpu.types import Operation
 
 _STOP = object()
@@ -84,6 +86,25 @@ class DualLedger:
 
     zero_copy_events = True  # both consumers only read the event rows
 
+    SHADOW_KEYS = (
+        "batches", "groups", "solo", "stage_s", "idle_s", "overlapped",
+    )
+
+    def instrument(self, metrics, tracer) -> None:
+        """Re-bind onto a shared registry/tracer (the replica's).
+        Accumulated values carry over; the shadow loop reads
+        self.shadow_stats/self.tracer per use, so a rebind while the
+        thread runs is safe (worst case one update lands in the old
+        group)."""
+        for key in self.SHADOW_KEYS:
+            metrics.counter(f"shadow.{key}").add(self.shadow_stats[key])
+        self.metrics = metrics
+        self.tracer = tracer
+        self.shadow_stats = metrics.group("shadow", self.SHADOW_KEYS)
+        # the shadow DeviceLedger's own instrumentation (group staging
+        # fence waits) reports into the same store
+        self.device.instrument(metrics, tracer)
+
     def __init__(
         self,
         acct_slots_log2: int = 16,
@@ -124,11 +145,13 @@ class DualLedger:
         # queue; overlapped = groups whose staging/dispatch completed
         # while the PREVIOUS group's kernel was still executing (the
         # double-buffer pipeline working as intended). BENCH reports
-        # overlapped/groups as shadow_upload_overlap.
-        self.shadow_stats = {
-            "batches": 0, "groups": 0, "solo": 0,
-            "stage_s": 0.0, "idle_s": 0.0, "overlapped": 0,
-        }
+        # overlapped/groups as shadow_upload_overlap. Registry-backed
+        # (metrics.py StatGroup under `shadow.`): instrument() re-binds
+        # onto the replica's shared registry so the [stats] line and the
+        # bench read the same store.
+        self.metrics = Metrics()
+        self.tracer = NULL_TRACER
+        self.shadow_stats = self.metrics.group("shadow", self.SHADOW_KEYS)
         self._restored = False  # device cannot follow a snapshot restore
         self._q: queue.Queue = queue.Queue(maxsize=queue_max)
         self._thread = threading.Thread(
@@ -251,13 +274,12 @@ class DualLedger:
         fold = jax.jit(fold_reply_codes)
         chk = jnp.uint64(0)
         group_max = DeviceLedger.GROUP_KS[0]
-        stats = self.shadow_stats
         prev_flat = None  # previous fused group's results (overlap probe)
         stop = False
         while not stop:
             t_wait = _time.perf_counter()
             run = [self._q.get()]
-            stats["idle_s"] += _time.perf_counter() - t_wait
+            self.shadow_stats.add("idle_s", _time.perf_counter() - t_wait)
             if run[0] is _STOP:
                 break
             # drain a run of queued create_transfers batches: one fused
@@ -292,9 +314,11 @@ class DualLedger:
                     pendings = None
                     if j - i >= 2:
                         t_stage = _time.perf_counter()
-                        pendings = self.device.try_execute_group_async(
-                            [(t, a) for _, t, a in run[i:j]]
-                        )
+                        with self.tracer.span("shadow.upload",
+                                              batches=j - i):
+                            pendings = self.device.try_execute_group_async(
+                                [(t, a) for _, t, a in run[i:j]]
+                            )
                     if pendings is not None:
                         g = pendings[0].group
                         m = j - i
@@ -307,14 +331,15 @@ class DualLedger:
                             jnp.asarray(active),
                         )
                         self._shadow_batches += m
-                        stats["batches"] += m
-                        stats["groups"] += 1
-                        stats["stage_s"] += _time.perf_counter() - t_stage
+                        stats = self.shadow_stats
+                        stats.add("batches", m)
+                        stats.add("groups")
+                        stats.add("stage_s", _time.perf_counter() - t_stage)
                         if prev_flat is not None and not prev_flat.is_ready():
                             # this group's staging + dispatch finished
                             # while the previous kernel was still running:
                             # the upload pipeline overlapped execution
-                            stats["overlapped"] += 1
+                            stats.add("overlapped")
                         prev_flat = g.results
                     else:
                         # fusion refused (a batch failed the fast-tier
@@ -325,17 +350,21 @@ class DualLedger:
                         # is not create_transfers (accounts): one batch.
                         end = j if j > i else i + 1
                         t_stage = _time.perf_counter()
-                        for op2, ts2, arr2 in run[i:end]:
-                            pending = self.device.execute_async(
-                                op2, ts2, arr2
-                            )
-                            chk = fold(
-                                chk, pending.results, jnp.int32(len(arr2))
-                            )
-                            self._shadow_batches += 1
-                            stats["batches"] += 1
-                            stats["solo"] += 1
-                        stats["stage_s"] += _time.perf_counter() - t_stage
+                        with self.tracer.span("shadow.upload",
+                                              batches=end - i, solo=True):
+                            for op2, ts2, arr2 in run[i:end]:
+                                pending = self.device.execute_async(
+                                    op2, ts2, arr2
+                                )
+                                chk = fold(
+                                    chk, pending.results,
+                                    jnp.int32(len(arr2)),
+                                )
+                                self._shadow_batches += 1
+                                self.shadow_stats.add("batches")
+                                self.shadow_stats.add("solo")
+                        self.shadow_stats.add(
+                            "stage_s", _time.perf_counter() - t_stage)
                         j = end
                     i = j
             except Exception as e:  # divergence surfaces at finalize

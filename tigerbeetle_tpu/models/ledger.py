@@ -66,10 +66,12 @@ from tigerbeetle_tpu.constants import (
     ConfigProcess,
 )
 from tigerbeetle_tpu.lsm import groove as groove_fields
+from tigerbeetle_tpu.metrics import NULL_METRICS
 from tigerbeetle_tpu.models import validate
 from tigerbeetle_tpu.models.validate import F_LINKED, F_PENDING, F_POST, F_VOID
 from tigerbeetle_tpu.ops import hashtable as ht
 from tigerbeetle_tpu.ops import u128
+from tigerbeetle_tpu.tracer import NULL_TRACER
 from tigerbeetle_tpu.types import Operation
 
 U64 = jnp.uint64
@@ -1712,6 +1714,18 @@ class DeviceLedger(HostLedgerBase):
     - "fast" / "serial": force one tier (parity testing).
     """
 
+    # observability seams (tigerbeetle_tpu/metrics.py, tracer.py);
+    # instrument() re-points them at a shared registry — the group-staging
+    # fence waits report there
+    metrics = NULL_METRICS
+    tracer = NULL_TRACER
+
+    def instrument(self, metrics, tracer) -> None:
+        self.metrics = metrics
+        self.tracer = tracer
+        if getattr(self, "spill", None) is not None:
+            self.spill.instrument(metrics, tracer)
+
     def __init__(
         self,
         cluster: ConfigCluster = DEFAULT_CLUSTER,
@@ -2012,7 +2026,9 @@ class DeviceLedger(HostLedgerBase):
             # state the fence is long retired and this is free; when the
             # device is more than two groups behind, it is exactly the
             # backpressure we want.
-            jax.block_until_ready(slot["fence"])
+            with self.tracer.span("ledger.staging_wait"), \
+                    self.metrics.histogram("ledger.staging_wait_us").time():
+                jax.block_until_ready(slot["fence"])
             slot["fence"] = None
         rows = slot["rows"]
         used = slot["used"]

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tigerbeetle_tpu import types
+from tigerbeetle_tpu.metrics import Metrics
 from tigerbeetle_tpu.models.ledger import (
     FAULT_CAPACITY,
     FAULT_CLAIM,
@@ -68,6 +69,7 @@ from tigerbeetle_tpu.models.ledger import (
 )
 from tigerbeetle_tpu.models.validate import F_POST, F_VOID
 from tigerbeetle_tpu.ops import hashtable as ht
+from tigerbeetle_tpu.tracer import NULL_TRACER
 
 U64 = jnp.uint64
 U32 = jnp.uint32
@@ -311,6 +313,29 @@ class SpillManager:
     spilled rows into lookups/extract.
     """
 
+    STAT_KEYS = (
+        "cycles", "spilled", "reloaded",
+        "t_scan", "t_gather_d2h", "t_stage",
+        "t_rebuild", "t_reload", "t_lsm_worker",
+        "prefetches", "prefetched",
+        "t_prefetch_worker", "t_prefetch_wait",
+        "lookup_batches", "lookup_ids",
+    )
+
+    def instrument(self, metrics, tracer) -> None:
+        """Re-bind onto a shared registry/tracer (the replica's, or the
+        bench driver's). Accumulated values carry over; the forest's trees
+        and grid report into the same registry."""
+        for key in self.STAT_KEYS:
+            metrics.counter(f"spill.{key}").add(self.stats[key])
+        self.metrics = metrics
+        self.tracer = tracer
+        self.stats = metrics.group("spill", self.STAT_KEYS)
+        for tree in self.forest._trees():
+            tree.metrics = metrics
+            tree.tracer = tracer
+        self.forest.grid.metrics = metrics
+
     def __init__(self, ledger, forest, keep_frac: float = 0.25,
                  async_io: bool = True, io=None):
         assert 0.0 < keep_frac < 1.0
@@ -336,14 +361,14 @@ class SpillManager:
         # BLOCKED on an unfinished prefetch (0 wait = the gather fully hid
         # behind the previous batch's commit). lookup_ids/lookup_batches =
         # multi-lookup amortization (mean ids per batched LSM read).
-        self.stats = {
-            "cycles": 0, "spilled": 0, "reloaded": 0,
-            "t_scan": 0.0, "t_gather_d2h": 0.0, "t_stage": 0.0,
-            "t_rebuild": 0.0, "t_reload": 0.0, "t_lsm_worker": 0.0,
-            "prefetches": 0, "prefetched": 0,
-            "t_prefetch_worker": 0.0, "t_prefetch_wait": 0.0,
-            "lookup_batches": 0, "lookup_ids": 0,
-        }
+        # `stats` is a registry-backed Mapping (tigerbeetle_tpu/metrics.py
+        # StatGroup under the `spill.` prefix): dict reads everywhere stay
+        # valid, and instrument() re-binds the storage onto the replica's /
+        # bench's shared registry so overlap_report and the [stats] line
+        # read the same numbers.
+        self.metrics = Metrics()
+        self.tracer = NULL_TRACER
+        self.stats = self.metrics.group("spill", self.STAT_KEYS)
         # the IO executor seam (see module docstring / ThreadedSpillIO vs
         # DeferredSpillIO); None = fully inline synchronous IO
         self._io = _make_io(async_io, io)
@@ -490,26 +515,30 @@ class SpillManager:
             "ful": slot["ful"],
             "by_id": {id_: j for j, id_ in enumerate(ids)},
         }
-        self.stats["prefetches"] += 1
+        self.stats.add("prefetches")
 
     def _prefetch_job(self, ids: list[int], slot: dict) -> None:
         import time as _time
 
         t0 = _time.perf_counter()
-        rows, ful = slot["rows"], slot["ful"]
-        missing: list[tuple[int, int]] = []
-        with self._staged_lock:
-            for j, id_ in enumerate(ids):
-                hit = self._staged.get(id_)
-                if hit is not None:
-                    rows[j] = hit[0]
-                    ful[j] = hit[1]
-                else:
-                    missing.append((j, id_))
-        if missing:
-            # FIFO position guarantees every earlier insert already landed
-            self._fetch_forest(missing, rows, ful)
-        self.stats["t_prefetch_worker"] += _time.perf_counter() - t0
+        tok = self.tracer.start("spill.prefetch_worker", ids=len(ids))
+        try:
+            rows, ful = slot["rows"], slot["ful"]
+            missing: list[tuple[int, int]] = []
+            with self._staged_lock:
+                for j, id_ in enumerate(ids):
+                    hit = self._staged.get(id_)
+                    if hit is not None:
+                        rows[j] = hit[0]
+                        ful[j] = hit[1]
+                    else:
+                        missing.append((j, id_))
+            if missing:
+                # FIFO position: every earlier insert already landed
+                self._fetch_forest(missing, rows, ful)
+            self.stats.add("t_prefetch_worker", _time.perf_counter() - t0)
+        finally:
+            self.tracer.stop(tok)
 
     def _consume_prefetch(self, ids, rows: np.ndarray,
                           ful: np.ndarray) -> list[tuple[int, int]]:
@@ -530,8 +559,10 @@ class SpillManager:
             return list(enumerate(ids))  # foreign batch: keep it armed
         self._prefetch = None
         t0 = _time.perf_counter()
-        self._io.wait(pf["fut"])  # pump-aware (DeferredSpillIO runs inline)
-        self.stats["t_prefetch_wait"] += _time.perf_counter() - t0
+        with self.tracer.span("spill.prefetch_wait"):
+            # pump-aware (DeferredSpillIO runs inline)
+            self._io.wait(pf["fut"])
+        self.stats.add("t_prefetch_wait", _time.perf_counter() - t0)
         prows, pful = pf["rows"], pf["ful"]
         remaining: list[tuple[int, int]] = []
         for i, id_ in enumerate(ids):
@@ -541,7 +572,7 @@ class SpillManager:
             else:
                 rows[i] = prows[j]
                 ful[i] = pful[j]
-                self.stats["prefetched"] += 1
+                self.stats.add("prefetched")
         return remaining
 
     # ------------------------------------------------------------------
@@ -549,6 +580,11 @@ class SpillManager:
     # ------------------------------------------------------------------
 
     def admit(self, arr: np.ndarray, n: int) -> None:
+        with self.tracer.span("spill.admit", n=n), \
+                self.metrics.histogram("spill.admit_us").time():
+            self._admit(arr, n)
+
+    def _admit(self, arr: np.ndarray, n: int) -> None:
         led = self.ledger
         # Capacity to free: the CONSERVATIVE occupancy transient, not the
         # true row growth. True growth is <= n + n_pv (an event's own id
@@ -622,8 +658,8 @@ class SpillManager:
             )
             rows[i] = np.frombuffer(row, dtype=np.uint32)
             ful[i] = f[0] if f else 0
-        self.stats["lookup_batches"] += 1
-        self.stats["lookup_ids"] += len(missing)
+        self.stats.add("lookup_batches")
+        self.stats.add("lookup_ids", len(missing))
 
     def _fetch_many(self, ids: list[int], rows: np.ndarray,
                     ful: np.ndarray) -> None:
@@ -670,7 +706,9 @@ class SpillManager:
                 "fence": None,
             }
         if slot["fence"] is not None:
-            jax.block_until_ready(slot["fence"])
+            with self.tracer.span("spill.staging_wait"), \
+                    self.metrics.histogram("spill.staging_wait_us").time():
+                jax.block_until_ready(slot["fence"])
             slot["fence"] = None
         return slot
 
@@ -705,8 +743,8 @@ class SpillManager:
             for id_ in chunk:
                 self.spilled.discard(id_)
             led._xfer_used += k
-            self.stats["reloaded"] += k
-        self.stats["t_reload"] += _time.perf_counter() - t0
+            self.stats.add("reloaded", k)
+        self.stats.add("t_reload", _time.perf_counter() - t0)
 
     def _stage_and_submit(self, rows: np.ndarray, ful: np.ndarray,
                           ids_lo: np.ndarray, ids_hi: np.ndarray,
@@ -758,7 +796,7 @@ class SpillManager:
                         del self._staged[key]
             # worker-thread seconds (accumulated under the stats lock's
             # coarse protection — a float add race would only smear stats)
-            self.stats["t_lsm_worker"] += _time.perf_counter() - t0
+            self.stats.add("t_lsm_worker", _time.perf_counter() - t0)
             if self._io is not None and self._io.settle_in_worker:
                 # threaded mode settles on the worker; sync/deferred mode
                 # leaves it to admit's _settle_forest (heal-retry context)
@@ -777,6 +815,10 @@ class SpillManager:
         compaction beats trading throughput for bounded memory). The scan
         and cold/hot split run ON DEVICE (SpillKernels.cycle_head /
         split_idx): the host fetches two words, not the whole table."""
+        with self.tracer.span("spill.cycle", need=need):
+            self._cycle(need)
+
+    def _cycle(self, need: int) -> None:
         import time as _time
 
         led = self.ledger
@@ -799,7 +841,7 @@ class SpillManager:
             st["xfer_rows"], jnp.int32(n_cold)
         )
         n_hot = live - n_cold
-        self.stats["t_scan"] += _time.perf_counter() - t0
+        self.stats.add("t_scan", _time.perf_counter() - t0)
         t0 = _time.perf_counter()
 
         # 1. Cold rows -> host. The d2h gather is synchronous (the spilled
@@ -826,7 +868,7 @@ class SpillManager:
             # later .view(uint8) reinterpretation rejects
             rows = np.ascontiguousarray(np.asarray(rows_d)[:k])
             ful = np.ascontiguousarray(np.asarray(ful_d)[:k])
-            self.stats["t_gather_d2h"] += _time.perf_counter() - t0
+            self.stats.add("t_gather_d2h", _time.perf_counter() - t0)
             t0 = _time.perf_counter()
             ids_lo = rows[:, 0].astype(np.uint64) | (
                 rows[:, 1].astype(np.uint64) << np.uint64(32)
@@ -842,8 +884,8 @@ class SpillManager:
                 (int(lo) | (int(hi) << 64))
                 for lo, hi in zip(ids_lo, ids_hi)
             )
-            self.stats["spilled"] += k
-            self.stats["t_stage"] += _time.perf_counter() - t0
+            self.stats.add("spilled", k)
+            self.stats.add("t_stage", _time.perf_counter() - t0)
             t0 = _time.perf_counter()
 
         # 2. Rebuild: fresh table, reinsert the hot tail (device-to-device;
@@ -880,8 +922,8 @@ class SpillManager:
         self._lo = np.sort(
             np.array([x & ((1 << 64) - 1) for x in self.spilled], dtype=np.uint64)
         )
-        self.stats["t_rebuild"] += _time.perf_counter() - t0
-        self.stats["cycles"] += 1
+        self.stats.add("t_rebuild", _time.perf_counter() - t0)
+        self.stats.add("cycles")
 
     # ------------------------------------------------------------------
     # lookup / extract merging

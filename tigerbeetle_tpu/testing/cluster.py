@@ -35,6 +35,8 @@ class Cluster:
         seed: int = 0,
         forest_blocks: int = 0,
         standby_count: int = 0,
+        metrics=None,
+        tracer=None,
     ):
         from tigerbeetle_tpu.constants import TEST_CLUSTER, TEST_PROCESS
 
@@ -66,6 +68,9 @@ class Cluster:
                 self.cluster_config, self.process_config, mode=mode,
                 backend_factory=backend_factory,
                 standby_count=standby_count,
+                # observability pass-through: a harness can hand every
+                # replica one shared registry/tracer (tests do)
+                metrics=metrics, tracer=tracer,
             )
             # thread timing must not leak into deterministic runs
             r.sync_payload_async = False

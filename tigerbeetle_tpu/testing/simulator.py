@@ -119,6 +119,7 @@ class Simulator:
         process=None,
         client_batch: int = 8,
         workload_knobs: dict | None = None,
+        trace_path: str | None = None,
     ):
         from tigerbeetle_tpu.constants import TEST_PROCESS
 
@@ -138,6 +139,20 @@ class Simulator:
         self.replica_count = replica_count  # ACTIVE replicas (quorums)
         self.standby_count = standby_count
         self.total_replicas = replica_count + standby_count
+
+        # Deterministic tracer mode: spans from every replica's commit
+        # path are timestamped with SIM TICKS (the virtual clock), and the
+        # canonical dump is byte-identical across runs of the same seed —
+        # two dumps of a diverging VOPR seed can be diffed directly. The
+        # tracer is pure observation: enabling it must leave the committed
+        # history unchanged (tested in tests/test_metrics.py).
+        self.trace_path = trace_path
+        if trace_path is not None:
+            from tigerbeetle_tpu.tracer import SimTracer
+
+            self.tracer = SimTracer(clock=lambda: self.net.tick_now)
+        else:
+            self.tracer = None
 
         self.net = PacketSimulator(
             seed * 31 + 1, self.total_replicas,
@@ -208,6 +223,7 @@ class Simulator:
             self.cluster_config, self.process_config,
             backend_factory=self.backend_factory,
             standby_count=self.standby_count,
+            tracer=self.tracer,
         )
         hist = self.histories[i]
 
@@ -444,8 +460,14 @@ class Simulator:
                 c.tick(now)
             self.net.tick()
 
-        self._heal_and_converge()
-        self._check()
+        try:
+            self._heal_and_converge()
+            self._check()
+        finally:
+            # dump even when a checker raises: a diverging seed's trace is
+            # exactly the artifact worth diffing against a healthy replay
+            if self.tracer is not None and self.trace_path is not None:
+                self.tracer.dump(self.trace_path)
         committed = max(
             (max(h) if h else 0) for h in self.histories
         )

@@ -1,19 +1,40 @@
 """Span tracer (reference: src/tracer.zig:48-77 — commit/prefetch/compact/
 io spans, backends none|Tracy).
 
-Backends here: `none` (no-op, zero overhead) and `json` (in-memory ring of
-spans dumped in Chrome trace-event format — load in about://tracing or
-Perfetto). Spans nest; the commit path and the bench driver emit them.
+Backends here:
+
+- `none` (the default everywhere): zero overhead — start/stop do nothing
+  and span() returns a shared singleton context manager, so hot paths stay
+  permanently instrumented (the CI smoke test pins the per-span cost);
+- `json` (JsonTracer): an in-memory RING of spans dumped in Chrome
+  trace-event format — load in about://tracing or Perfetto. When the ring
+  is full the OLDEST events are overwritten (a long run keeps its tail,
+  the part you are debugging); spans still open at dump() are emitted as
+  incomplete `ph: "B"` events rather than silently dropped.
+- deterministic (SimTracer / any JsonTracer with a virtual clock): spans
+  are timestamped with SIMULATOR TICKS instead of wall time, and dump()
+  writes canonical JSON (sorted keys, fixed separators) — the same VOPR
+  seed produces a byte-identical trace across runs, so two dumps can be
+  diffed when a seed diverges.
+
+Spans nest; the commit path, message bus, journal, LSM, spill pipeline and
+the bench driver emit them. A JsonTracer constructed with `metrics=` also
+feeds each completed span's duration into the registry histogram
+`span.<name>` (tigerbeetle_tpu/metrics.py), so trace runs get percentile
+snapshots for free.
 """
 
 from __future__ import annotations
 
 import json
+import threading
 import time
 
 
 class Tracer:
     """No-op base (the `none` backend)."""
+
+    enabled = False
 
     def start(self, name: str, **args) -> int:
         return 0
@@ -22,13 +43,15 @@ class Tracer:
         pass
 
     def span(self, name: str, **args):
-        return _NullSpan()
+        return _NULL_SPAN
 
     def dump(self, path: str) -> None:
         pass
 
 
 class _NullSpan:
+    __slots__ = ()
+
     def __enter__(self):
         return self
 
@@ -36,38 +59,106 @@ class _NullSpan:
         return False
 
 
+_NULL_SPAN = _NullSpan()
+NULL_TRACER = Tracer()
+
+
 class JsonTracer(Tracer):
-    def __init__(self, capacity: int = 65536):
+    """Ring of complete events in Chrome trace format.
+
+    `clock` defaults to wall time (perf_counter_ns; ts_div=1000 converts
+    to the microseconds Chrome traces use). A deterministic harness passes
+    a virtual clock (ticks) and ts_div=1.0 — see SimTracer."""
+
+    enabled = True
+
+    def __init__(self, capacity: int = 65536, clock=None,
+                 ts_div: float = 1000.0, metrics=None, pid: int = 0):
+        assert capacity > 0
         self.events: list[dict] = []
         self.capacity = capacity
+        self.clock = clock if clock is not None else time.perf_counter_ns
+        self.ts_div = ts_div
+        self.metrics = metrics  # optional: span durations -> histograms
+        self.pid = pid
         self._next = 1
+        self._head = 0  # ring overwrite position once at capacity
         self._open: dict[int, tuple[str, int, dict]] = {}
+        # spans stop from worker threads too (journal writer, spill IO).
+        # REENTRANT: the server's SIGTERM handler dumps the trace on the
+        # same main thread that may be interrupted inside start()/stop() —
+        # a plain Lock would deadlock the shutdown dump.
+        self._lock = threading.RLock()
 
     def start(self, name: str, **args) -> int:
-        token = self._next
-        self._next += 1
-        self._open[token] = (name, time.perf_counter_ns(), args)
+        with self._lock:
+            token = self._next
+            self._next += 1
+            self._open[token] = (name, self.clock(), args)
         return token
 
     def stop(self, token: int) -> None:
-        name, t0, args = self._open.pop(token)
-        if len(self.events) < self.capacity:
-            self.events.append({
+        now = self.clock()
+        with self._lock:
+            name, t0, args = self._open.pop(token)
+            event = {
                 "name": name,
                 "ph": "X",  # complete event
-                "ts": t0 / 1000,  # Chrome traces are in microseconds
-                "dur": (time.perf_counter_ns() - t0) / 1000,
-                "pid": 0,
+                "ts": t0 / self.ts_div,
+                "dur": (now - t0) / self.ts_div,
+                "pid": self.pid,
                 "tid": 0,
                 "args": args,
-            })
+            }
+            if len(self.events) < self.capacity:
+                self.events.append(event)
+            else:
+                # ring: overwrite the oldest (keep the newest tail)
+                self.events[self._head] = event
+                self._head = (self._head + 1) % self.capacity
+        if self.metrics is not None:
+            if self.ts_div == 1000.0:  # wall clock: dur is already ns
+                self.metrics.histogram(f"span.{name}").observe(
+                    (now - t0) / 1000.0
+                )
 
     def span(self, name: str, **args):
         return _Span(self, name, args)
 
+    def events_ordered(self) -> list[dict]:
+        """Events oldest-first (unwrapping the ring), then any still-open
+        spans as incomplete `ph: "B"` begin events."""
+        with self._lock:
+            out = self.events[self._head:] + self.events[: self._head]
+            for token in sorted(self._open):
+                name, t0, args = self._open[token]
+                out.append({
+                    "name": name,
+                    "ph": "B",  # begin without end: incomplete at dump
+                    "ts": t0 / self.ts_div,
+                    "pid": self.pid,
+                    "tid": 0,
+                    "args": args,
+                })
+            return out
+
     def dump(self, path: str) -> None:
+        # canonical encoding (sorted keys, fixed separators): with a
+        # deterministic clock the dump is byte-identical across runs
         with open(path, "w") as f:
-            json.dump({"traceEvents": self.events}, f)
+            json.dump({"traceEvents": self.events_ordered()}, f,
+                      sort_keys=True, separators=(",", ":"))
+
+
+class SimTracer(JsonTracer):
+    """Deterministic tracer for the simulator/VOPR: timestamps are sim
+    ticks (the virtual clock the whole cluster runs on), so a seed's trace
+    is byte-identical across runs and two dumps of a diverging seed can be
+    diffed line by line."""
+
+    def __init__(self, clock, capacity: int = 65536, pid: int = 0):
+        super().__init__(capacity=capacity, clock=clock, ts_div=1.0,
+                         pid=pid)
 
 
 class _Span:

@@ -21,10 +21,18 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 from tigerbeetle_tpu.constants import ConfigCluster
 from tigerbeetle_tpu.io.storage import SECTOR_SIZE, Storage, Zone
+from tigerbeetle_tpu.metrics import NULL_METRICS
+from tigerbeetle_tpu.tracer import NULL_TRACER
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
 
 
 class Journal:
+    # observability seams — the owning replica (or the composition root)
+    # re-points these at its shared registry/tracer; defaults are the
+    # zero-cost no-op backends
+    metrics = NULL_METRICS
+    tracer = NULL_TRACER
+
     def __init__(self, storage: Storage, cluster: ConfigCluster):
         self.storage = storage
         self.cluster = cluster
@@ -62,10 +70,14 @@ class Journal:
         assert header.size == HEADER_SIZE + len(body)
         assert header.size <= self.msg_max
         slot = self.slot_for_op(header.op)
-        self.storage.write(
-            Zone.wal_prepares, slot * self.msg_max, header.to_bytes() + body
-        )
-        self._write_header(slot, header)
+        with self.tracer.span("journal.write_prepare", op=header.op), \
+                self.metrics.histogram("journal.write_us").time():
+            self.storage.write(
+                Zone.wal_prepares, slot * self.msg_max,
+                header.to_bytes() + body,
+            )
+            self._write_header(slot, header)
+        self.metrics.counter("journal.writes").add()
         from tigerbeetle_tpu import constants
 
         if constants.VERIFY:
@@ -151,13 +163,20 @@ class Journal:
         # a slot's header enters the DURABLE mirror only here — after its
         # own prepare landed — so a neighbor's sector write can never
         # publish a header whose prepare is still in flight.
-        self.storage.write(Zone.wal_prepares, slot * self.msg_max, hb + body)
-        off = slot * HEADER_SIZE
-        with self._locks_guard:
-            lock = self._sector_locks.setdefault(sector, threading.Lock())
-        with lock:
-            self._headers_durable[off : off + HEADER_SIZE] = hb
-            self._write_header_sector(sector)
+        with self.tracer.span("journal.write_prepare", slot=slot), \
+                self.metrics.histogram("journal.write_us").time():
+            self.storage.write(
+                Zone.wal_prepares, slot * self.msg_max, hb + body
+            )
+            off = slot * HEADER_SIZE
+            with self._locks_guard:
+                lock = self._sector_locks.setdefault(
+                    sector, threading.Lock()
+                )
+            with lock:
+                self._headers_durable[off : off + HEADER_SIZE] = hb
+                self._write_header_sector(sector)
+        self.metrics.counter("journal.writes").add()
 
     def invalidate_above(self, op_max: int) -> None:
         """Destroy journal evidence for every op above `op_max` — BOTH the

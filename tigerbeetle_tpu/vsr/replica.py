@@ -36,14 +36,17 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
+from time import perf_counter_ns
 
 from tigerbeetle_tpu.constants import ConfigCluster, ConfigProcess
 from tigerbeetle_tpu.io.network import Network
 from tigerbeetle_tpu.io.storage import Storage
 from tigerbeetle_tpu.io.time import Time
 from tigerbeetle_tpu.lsm.grid import GridBlockCorrupt
+from tigerbeetle_tpu.metrics import Metrics
 from tigerbeetle_tpu.models.ledger import DeviceLedger
 from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.tracer import NULL_TRACER
 from tigerbeetle_tpu.types import Operation
 from tigerbeetle_tpu.vsr.client_replies import ClientReplies
 from tigerbeetle_tpu.vsr.clock import Clock
@@ -88,7 +91,17 @@ class Replica:
         backend_factory=None,
         standby_count: int = 0,
         spill_io: str = "deferred",
+        metrics=None,
+        tracer=None,
     ):
+        # Observability seams (tigerbeetle_tpu/metrics.py, tracer.py): one
+        # registry and one tracer per replica, threaded into the journal,
+        # the ledger backend and the spill pipeline below, so every stage
+        # of the commit path reports into the SAME store. The default
+        # registry is always live (counters are cheap ints); the default
+        # tracer is the no-op `none` backend.
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.replica = replica_index
         self.replica_count = replica_count
         # Standbys (reference: src/vsr/replica.zig:163-175): replicas with
@@ -138,8 +151,19 @@ class Replica:
             # NOT — see DeviceLedger.prefetch_results)
             backend.prefetch_results = True
         self.ledger = backend
+        # thread the observability seams through the stack: the backend's
+        # staging fences, the spill pipeline (prefetch/admit/cycle spans)
+        # and the WAL writes all report into this replica's registry
+        if hasattr(backend, "instrument"):
+            backend.instrument(self.metrics, self.tracer)
+        else:
+            spill = getattr(backend, "spill", None)
+            if spill is not None and hasattr(spill, "instrument"):
+                spill.instrument(self.metrics, self.tracer)
         self.sm = StateMachine(backend, cluster)
         self.journal = Journal(storage, cluster)
+        self.journal.metrics = self.metrics
+        self.journal.tracer = self.tracer
         self.superblock = SuperBlock(storage)
         self.client_replies = ClientReplies(storage, cluster)
         self.storage = storage
@@ -195,8 +219,18 @@ class Replica:
         self._wal_scrub_cursor = 1  # continuous WAL repair sweep position
         # group-commit observability (BENCH reports the hit rate): ops
         # committed via a fused device dispatch vs per-op fallback, plus
-        # the group count (fused_ops / fused_groups = mean fusion width)
-        self.group_stats = {"fused_ops": 0, "solo_ops": 0, "fused_groups": 0}
+        # the group count (fused_ops / fused_groups = mean fusion width).
+        # A registry-backed Mapping: readers keep dict access, the storage
+        # lives in self.metrics (the shared pipeline registry).
+        self.group_stats = self.metrics.group(
+            "commit.group", ("fused_ops", "solo_ops", "fused_groups")
+        )
+        # commit-pipeline timing histograms (metrics.py CATALOG for units)
+        self._h_quorum = self.metrics.histogram("replica.quorum_wait_us")
+        self._h_dispatch = self.metrics.histogram("replica.commit_dispatch_us")
+        self._h_finalize = self.metrics.histogram("replica.commit_finalize_us")
+        self._h_fuse = self.metrics.histogram("replica.fuse_hold_us")
+        self._fuse_token = 0  # open fuse_hold trace span, if any
         # test/simulator observation hook: called on every committed prepare
         self.commit_hook = None
         # observation hook on every reply built at finalize (hash_log:
@@ -324,6 +358,12 @@ class Replica:
         ops beyond it stay replayable in the WAL). The replicated client
         table rides in the snapshot meta — it is part of the replicated
         state (reference: src/vsr/superblock.zig ClientSessions trailer)."""
+        with self.tracer.span("replica.checkpoint", op=self.commit_min), \
+                self.metrics.histogram("replica.checkpoint_us").time():
+            self._checkpoint()
+        self.metrics.counter("replica.checkpoints").add()
+
+    def _checkpoint(self) -> None:
         self.flush_commits()  # snapshot sees finalized client-table state
         # Queued reply-slot writes must land before the client table (with
         # their checksums) is persisted: a crash after the superblock commit
@@ -699,7 +739,11 @@ class Replica:
         self.op = op
         self.parent_checksum = prepare.checksum
         self.pipeline[op] = {"header": prepare, "body": body,
-                             "oks": {self.replica}, "wal": wal}
+                             "oks": {self.replica}, "wal": wal,
+                             # quorum-wait accounting: broadcast -> quorum
+                             "t": perf_counter_ns(),
+                             "qtok": self.tracer.start(
+                                 "replica.quorum_wait", op=op)}
         # Stream prepares to standbys too (they journal + commit but never
         # ack — _ack_prepare declines): without this a standby would learn
         # each op only via a commit heartbeat plus one request_prepare round
@@ -848,6 +892,7 @@ class Replica:
         single replica) — the caller should treat corruption as fatal."""
         if self.forest is None or self.replica_count == 1:
             return False
+        self.metrics.counter("grid.repair_requests").add()
         self._grid_missing.update(addresses)
         body = b"".join(
             a.to_bytes(8, "little") for a in sorted(self._grid_missing)
@@ -1306,6 +1351,28 @@ class Replica:
 
         spill.prefetch_async(np.frombuffer(body, dtype=TRANSFER_DTYPE))
 
+    def _drop_quorum_tokens(self) -> None:
+        """Close the quorum-wait spans of pipeline entries about to be
+        discarded (view change): without this a traced run leaks one open
+        span per abandoned prepare into the dump. The histogram is NOT
+        observed — these ops never reached quorum here."""
+        for entry in self.pipeline.values():
+            entry.pop("t", None)
+            tok = entry.pop("qtok", 0)
+            if tok:
+                self.tracer.stop(tok)
+
+    def _note_quorum(self, entry: dict) -> None:
+        """Close a pipeline entry's quorum-wait accounting (histogram +
+        trace span). Idempotent: the stall/retry paths can re-enter the
+        commit for the same op."""
+        t = entry.pop("t", None)
+        if t is not None:
+            self._h_quorum.observe((perf_counter_ns() - t) / 1000.0)
+        tok = entry.pop("qtok", 0)
+        if tok:
+            self.tracer.stop(tok)
+
     def _maybe_commit_pipeline(self) -> None:
         committed = False
         while True:
@@ -1314,6 +1381,7 @@ class Replica:
             if entry is None or len(entry["oks"]) < self.quorum_replication:
                 break
             header, body = entry["header"], entry["body"]
+            self._note_quorum(entry)
             try:
                 if self.commit_window > 0:
                     if self._commit_group(op, header):
@@ -1325,7 +1393,7 @@ class Replica:
                     d = self._commit_dispatch(header, body)
                     d["wal"] = entry.get("wal")
                     self._inflight.append(d)
-                    self.group_stats["solo_ops"] += 1
+                    self.group_stats.add("solo_ops")
                     self.flush_commits(keep=self.commit_window, only_ready=True)
                 else:
                     reply_wire = self._commit_prepare(header, body)
@@ -1381,14 +1449,15 @@ class Replica:
             return False  # ineligible (hazard tier / spill / mode)
         for e, handle in zip(run, handles):
             h = e["header"]
+            self._note_quorum(e)
             d = self._commit_dispatch(h, e["body"], handle=handle)
             d["wal"] = e.get("wal")
             self._inflight.append(d)
             self.commit_min = self.commit_max = h.op
             self.commit_checksum = h.checksum
             del self.pipeline[h.op]
-        self.group_stats["fused_ops"] += len(run)
-        self.group_stats["fused_groups"] += 1
+        self.group_stats.add("fused_ops", len(run))
+        self.group_stats.add("fused_groups")
         self.flush_commits(keep=self.commit_window, only_ready=True)
         return True
 
@@ -1461,7 +1530,9 @@ class Replica:
                 return
             self.commit_min = op
             self.commit_checksum = header.checksum
-            self.pipeline.pop(op, None)  # prune if it was pipelined
+            pruned = self.pipeline.pop(op, None)  # prune if pipelined
+            if pruned is not None:
+                self._note_quorum(pruned)
             # backup-side prefetch/commit overlap: peek the next journaled
             # prepare (gated on a threaded executor + an active spilled
             # set — the read costs a WAL slot fetch, worthless when the
@@ -1487,6 +1558,12 @@ class Replica:
 
     def _commit_dispatch(self, header: Header, body: bytes,
                          handle=None) -> dict:
+        with self.tracer.span("replica.commit_dispatch", op=header.op), \
+                self._h_dispatch.time():
+            return self._commit_dispatch_inner(header, body, handle)
+
+    def _commit_dispatch_inner(self, header: Header, body: bytes,
+                               handle=None) -> dict:
         """Stage 1: apply the prepare to the replicated state WITHOUT
         materializing device results (JAX async dispatch — create-op
         launches are queued and the host returns). Host-side effects that
@@ -1552,6 +1629,12 @@ class Replica:
         }
 
     def _commit_finalize(self, entry: dict) -> bytes | None:
+        with self.tracer.span("replica.commit_finalize",
+                              op=entry["header"].op), \
+                self._h_finalize.time():
+            return self._commit_finalize_inner(entry)
+
+    def _commit_finalize_inner(self, entry: dict) -> bytes | None:
         """Stage 2: materialize the results (drains the device batch),
         build + store the reply, persist the client-replies slot."""
         header = entry["header"]
@@ -1670,11 +1753,24 @@ class Replica:
         fused dispatch — the difference between a ~0.4 and a ~0.9 group-
         commit hit rate under concurrent session clients."""
         if not (self.status == "normal" and self.is_primary and self.pipeline):
-            self._fuse_started = None
+            self._fuse_clear()
             return
         if self._fuse_hold():
             return
         self._maybe_commit_pipeline()
+
+    def _fuse_clear(self) -> None:
+        """End the fuse-window hold: close its trace span and record the
+        hold duration (Time-seam clock, so deterministic harnesses stay
+        deterministic)."""
+        if self._fuse_started is not None:
+            self._h_fuse.observe(
+                (self.time.monotonic() - self._fuse_started) / 1000.0
+            )
+        self._fuse_started = None
+        if self._fuse_token:
+            self.tracer.stop(self._fuse_token)
+            self._fuse_token = 0
 
     def _fuse_hold(self) -> bool:
         """True while the fuse window is holding a short quorum-ready run
@@ -1687,7 +1783,7 @@ class Replica:
             or self.fuse_window_ns <= 0
             or not self._inflight
         ):
-            self._fuse_started = None
+            self._fuse_clear()
             return False
         run = 0
         first = self.commit_min + 1
@@ -1701,15 +1797,18 @@ class Replica:
                 break
             run += 1
         if run == 0 or run >= self.GROUP_MAX:
-            self._fuse_started = None
+            self._fuse_clear()
             return False
         now = self.time.monotonic()
         if self._fuse_started is None:
             self._fuse_started = now
+            self._fuse_token = self.tracer.start(
+                "replica.fuse_hold", run=run
+            )
             return True
         if now - self._fuse_started < self.fuse_window_ns:
             return True
-        self._fuse_started = None
+        self._fuse_clear()
         return False
 
     def commits_ready(self) -> bool:
@@ -1747,6 +1846,7 @@ class Replica:
         self._dvc = {}
         self._adopt = None
         self._catchup = {}
+        self._drop_quorum_tokens()
         self.pipeline = {}
         self._pending_prepares = {}
         self._repair_wanted.clear()
@@ -2214,6 +2314,7 @@ class Replica:
         self.flush_commits()  # no async commits across a status change
         self.status = "view_change"
         self.view_candidate = header.view
+        self._drop_quorum_tokens()
         self.pipeline = {}
         self._pending_prepares = {}
         self._repair_wanted.clear()
