@@ -652,6 +652,33 @@ def bench_e2e(stage, trace: bool = False) -> dict:
     except Exception as e:
         out["device_backend"] = {"error": f"{type(e).__name__}: {e}"}
         print(f"[e2e device] FAILED: {e}", file=sys.stderr)
+    try:
+        # CDC A/B: same backend/driver/batch protocol as the headline
+        # durable run, with a live change-stream pump attached to a
+        # DELIBERATELY slow (refusing, never blocking) sink. The contract
+        # under measurement: durable_cdc_tps within noise of durable_tps
+        # — backpressure pauses the pump (cdc_backpressure_pauses), the
+        # stream lags (cdc_lag_ops), the commit path never waits.
+        with stage("e2e_cdc"):
+            from tigerbeetle_tpu.benchmark import run_e2e
+
+            cdc = run_e2e(
+                n_accounts=N_ACCOUNTS,
+                n_transfers=int(os.environ.get("BENCH_E2E_CDC", 1_000_000)),
+                clients=clients, backend="native+device", driver=driver,
+                # ~50 ops/s sink ceiling — well below the durable commit
+                # rate, so the sink genuinely saturates and the lag/pause
+                # counters prove the pump (not the replica) absorbed it
+                cdc_slow_us=20_000, log=log,
+            )
+        out["cdc"] = cdc
+        out["durable_cdc_tps"] = cdc["durable_tps"]
+        out["cdc_lag_ops"] = cdc.get("cdc_lag_ops")
+        out["cdc_backpressure_pauses"] = cdc.get("cdc_backpressure_pauses")
+        out["cdc_ops_streamed"] = cdc.get("cdc_ops_streamed")
+    except Exception as e:
+        out["cdc"] = {"error": f"{type(e).__name__}: {e}"}
+        print(f"[e2e cdc] FAILED: {e}", file=sys.stderr)
     return out
 
 
@@ -1006,6 +1033,12 @@ def main() -> None:
                 "durable_two_phase_tps": e2e.get("durable_two_phase_tps", 0.0),
                 "durable_shadow_verified_all": e2e.get("shadow_verified_all"),
                 "durable_device_tps": e2e.get("durable_device_tps", 0.0),
+                # CDC A/B: live change stream into a deliberately slow
+                # sink — throughput must hold vs durable_tps while the
+                # pump (not the replica) absorbs the backpressure
+                "durable_cdc_tps": e2e.get("durable_cdc_tps", 0.0),
+                "cdc_lag_ops": e2e.get("cdc_lag_ops"),
+                "cdc_backpressure_pauses": e2e.get("cdc_backpressure_pauses"),
                 "group_commit_hit_rate": e2e.get("group_commit_hit_rate", 0.0),
                 "group_fuse_width": e2e.get("group_fuse_width"),
                 "shadow_upload_overlap": e2e.get("shadow_upload_overlap"),

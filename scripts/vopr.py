@@ -31,28 +31,37 @@ from tigerbeetle_tpu.testing.simulator import (  # noqa: E402
 
 
 VERIFY_FRACTION_DEFAULT = 0.25
+CDC_FRACTION_DEFAULT = 0.2
 
 
 def run_seed(seed: int, ticks: int, device_fraction: float,
              fixed: bool,
              verify_fraction: float = VERIFY_FRACTION_DEFAULT,
+             cdc_fraction: float = CDC_FRACTION_DEFAULT,
              trace_path: str | None = None,
              ) -> tuple[dict | None, str, str | None]:
     """(stats, topology-line, error) for one seed. A `verify_fraction`
     slice of seeds runs with the intensive online-verification tier
     (constants.VERIFY — reference src/constants.zig:592): hash-chain
     re-checks at commit, LSM level audits, journal read-after-write,
-    oracle conservation audits."""
+    oracle conservation audits. A `cdc_fraction` slice runs the
+    deterministic CDC consumer (crash/restart schedule seeded, checker
+    proves no gaps / no duplicated effects)."""
     from tigerbeetle_tpu import constants
 
     if fixed:
         opts: dict = {}
         desc = "fixed r3+s0 c2 oracle"
-        verify = False
+        verify = cdc = False
     else:
         opts = random_options(seed, device_fraction=device_fraction)
         verify = (seed * 2654435761 % 100) < verify_fraction * 100
+        # a distinct multiplier decorrelates the CDC draw from VERIFY's
+        cdc = (seed * 2246822519 % 100) < cdc_fraction * 100
         desc = describe_options(opts) + (" VERIFY" if verify else "")
+        if cdc:
+            desc += " CDC"
+            opts["cdc_consumer"] = True
     kw = {"ticks": ticks, **opts}
     if trace_path is not None:
         # deterministic tick-stamped trace (tracer.SimTracer): the same
@@ -84,6 +93,10 @@ def main() -> int:
                     default=VERIFY_FRACTION_DEFAULT,
                     help="fraction of seeds run with the intensive "
                          "online-verification tier (constants.VERIFY)")
+    ap.add_argument("--cdc-fraction", type=float,
+                    default=CDC_FRACTION_DEFAULT,
+                    help="fraction of seeds run with the deterministic "
+                         "CDC consumer (crash/restart + stream checker)")
     ap.add_argument("--fixed", action="store_true",
                     help="legacy fixed topology (3 replicas / 2 clients)")
     ap.add_argument("--json", default=None,
@@ -101,6 +114,7 @@ def main() -> int:
         stats, desc, err = run_seed(
             seed, args.ticks, args.device_fraction, args.fixed,
             verify_fraction=args.verify_fraction,
+            cdc_fraction=args.cdc_fraction,
             trace_path=(
                 f"{args.trace}.{seed}.json" if args.trace else None
             ),
@@ -120,10 +134,11 @@ def main() -> int:
         if sink:
             rec = {"seed": seed, "ticks": args.ticks, "topology": desc,
                    "device_fraction": args.device_fraction,
-                   # the VERIFY-slice draw depends on verify_fraction, not
-                   # the seed alone: record it so hub replays stay
-                   # reproducible if the default ever changes
+                   # the VERIFY/CDC-slice draws depend on their fractions,
+                   # not the seed alone: record them so hub replays stay
+                   # reproducible if the defaults ever change
                    "verify_fraction": args.verify_fraction,
+                   "cdc_fraction": args.cdc_fraction,
                    "fixed": args.fixed, "ok": err is None}
             rec["error" if err else "stats"] = err or stats
             sink.write(json.dumps(rec) + "\n")
@@ -138,6 +153,8 @@ def main() -> int:
             extra += f" --device-fraction {args.device_fraction}"
         if args.verify_fraction != VERIFY_FRACTION_DEFAULT:
             extra += f" --verify-fraction {args.verify_fraction}"
+        if args.cdc_fraction != CDC_FRACTION_DEFAULT:
+            extra += f" --cdc-fraction {args.cdc_fraction}"
         if args.fixed:
             extra += " --fixed"
         print("replay failures with: python scripts/vopr.py "

@@ -61,7 +61,11 @@ def replay(rec: dict) -> tuple[bool, str | None]:
     """Re-run one failing record's seed with the SAME mode flags the
     fleet used (recorded per seed — the topology draw depends on
     device_fraction/fixed, not the seed alone)."""
-    from scripts.vopr import VERIFY_FRACTION_DEFAULT, run_seed
+    from scripts.vopr import (
+        CDC_FRACTION_DEFAULT,
+        VERIFY_FRACTION_DEFAULT,
+        run_seed,
+    )
 
     _, _, err = run_seed(
         rec["seed"], rec["ticks"],
@@ -72,6 +76,7 @@ def replay(rec: dict) -> tuple[bool, str | None]:
         verify_fraction=rec.get(
             "verify_fraction", VERIFY_FRACTION_DEFAULT
         ),
+        cdc_fraction=rec.get("cdc_fraction", CDC_FRACTION_DEFAULT),
     )
     return err is not None, err
 

@@ -330,3 +330,65 @@ def test_none_backend_commit_loop_smoke():
     assert r.metrics.histogram("replica.commit_dispatch_us").count >= (
         n_batches
     )
+
+
+# -- CDC metric names are cataloged (units included) -------------------
+
+
+def test_cdc_metric_names_all_cataloged():
+    """Every metric a CdcPump run creates must be in metrics.CATALOG so
+    the [stats] line and --statsd emit them without unknown-metric
+    fallbacks (the pump's names are the CATALOG's cdc.* section)."""
+    import numpy as np
+
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.cdc import CdcPump, MemoryCursor, MemorySink
+    from tigerbeetle_tpu.metrics import CATALOG
+    from tigerbeetle_tpu.models.oracle import OracleStateMachine
+    from tigerbeetle_tpu.testing.cluster import Cluster
+    from tigerbeetle_tpu.types import Operation
+
+    cluster = Cluster(replica_count=1, backend_factory=OracleStateMachine)
+    r = cluster.replicas[0]
+    # a resuming pump with a poisoned cursor also creates the
+    # resume-fork counter — exercise that path too
+    cursor = MemoryCursor()
+    pump = CdcPump(r, MemorySink(), cursor, ack_interval=1)
+    pump.attach()
+    client = cluster.add_client()
+    acct = np.zeros(2, dtype=types.ACCOUNT_DTYPE)
+    acct["id_lo"] = [1, 2]
+    acct["ledger"] = 1
+    acct["code"] = 1
+    cluster.execute(client, Operation.create_accounts, acct.tobytes())
+    while pump.pump():
+        pass
+    pump.detach()
+    cursor.checksum = 0xBAD  # checksum that cannot match the log
+    pump2 = CdcPump(r, MemorySink(), cursor)
+    pump2.attach()
+    import io
+    import sys as _sys
+
+    err = io.StringIO()
+    orig, _sys.stderr = _sys.stderr, err
+    try:
+        pump2.pump()
+    finally:
+        _sys.stderr = orig
+    assert "mismatch" in err.getvalue()
+    snap = r.metrics.snapshot()
+    cdc_names = {
+        n
+        for section in ("counters", "gauges", "histograms")
+        for n in snap[section]
+        if n.startswith("cdc.")
+    }
+    assert cdc_names  # the pump really reported here
+    missing = cdc_names - set(CATALOG)
+    assert not missing, f"cdc metrics missing from CATALOG: {missing}"
+    # and the catalog entries carry units + kinds like the rest
+    for name in cdc_names:
+        kind, unit, help_ = CATALOG[name]
+        assert kind in ("counter", "gauge", "histogram")
+        assert isinstance(unit, str) and help_

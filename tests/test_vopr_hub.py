@@ -55,29 +55,33 @@ def test_ingest_groups_and_files_reports(tmp_path):
 
 
 def test_replay_passes_recorded_verify_fraction(monkeypatch):
-    """Hub replays must reproduce the fleet's VERIFY-slice draw exactly:
-    the per-seed record carries verify_fraction (like device_fraction and
-    fixed) and replay() passes it through; a legacy record without the
-    field falls back to the fleet default."""
+    """Hub replays must reproduce the fleet's slice draws exactly: the
+    per-seed record carries verify_fraction AND cdc_fraction (like
+    device_fraction and fixed) and replay() passes them through; a legacy
+    record without the fields falls back to the fleet defaults."""
     import scripts.vopr as vopr_mod
     from scripts.vopr_hub import replay
 
     seen = {}
 
     def fake_run_seed(seed, ticks, device_fraction=0.0, fixed=False,
-                      verify_fraction=None):
-        seen.update(seed=seed, verify_fraction=verify_fraction)
+                      verify_fraction=None, cdc_fraction=None):
+        seen.update(seed=seed, verify_fraction=verify_fraction,
+                    cdc_fraction=cdc_fraction)
         return None, "r3", None
 
     monkeypatch.setattr(vopr_mod, "run_seed", fake_run_seed)
     rec = {"seed": 7, "ticks": 50, "topology": "r3 c2",
-           "verify_fraction": 0.6, "ok": False, "error": "X"}
+           "verify_fraction": 0.6, "cdc_fraction": 0.5,
+           "ok": False, "error": "X"}
     replay(rec)
     assert seen["verify_fraction"] == 0.6
-    # legacy record (pre-field): the default applies
+    assert seen["cdc_fraction"] == 0.5
+    # legacy record (pre-field): the defaults apply
     replay({"seed": 8, "ticks": 50, "topology": "r3 c2",
             "ok": False, "error": "X"})
     assert seen["verify_fraction"] == vopr_mod.VERIFY_FRACTION_DEFAULT
+    assert seen["cdc_fraction"] == vopr_mod.CDC_FRACTION_DEFAULT
 
 
 def test_hub_clean_fleet_exits_zero(tmp_path):

@@ -13,6 +13,7 @@ simply fails validation and ends the replay.
 from __future__ import annotations
 
 import os
+import sys
 
 from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
 
@@ -48,21 +49,36 @@ class AOF:
 
 
 def replay(path: str):
-    """Yield (header, body) for every valid record, stopping at the first
-    torn/corrupt one (reference: AOF replay tool, src/aof.zig)."""
+    """Yield (header, body) for every valid record, stopping at the last
+    intact one (reference: AOF replay tool, src/aof.zig).
+
+    A crash mid-append leaves a torn tail: a partial magic/size prefix, a
+    header cut short, a body cut short, or intact bytes whose checksums
+    no longer authenticate. Every such shape STOPS the replay at the last
+    valid record — never raises — and leaves one warning on stderr (the
+    operator should know the log ends in a tear rather than cleanly; the
+    replayed prefix is still the complete durable history, because the
+    torn record's reply can never have left the replica: the AOF append
+    completes before the reply is sent)."""
     with open(path, "rb") as f:
         data = f.read()
     off = 0
     while off + 16 + HEADER_SIZE <= len(data):
         if int.from_bytes(data[off : off + 8], "little") != MAGIC:
-            return
+            break
         size = int.from_bytes(data[off + 8 : off + 16], "little")
         if size < HEADER_SIZE or off + 16 + size > len(data):
-            return
+            break
         header = Header.from_bytes(data[off + 16 : off + 16 + HEADER_SIZE])
         body = data[off + 16 + HEADER_SIZE : off + 16 + size]
         if not header.valid_checksum() or not header.valid_checksum_body(body):
-            return
+            break
         yield header, body
         off += 16 + size
         off += (-off) % SECTOR
+    if off < len(data):
+        sys.stderr.write(
+            f"aof: {path}: torn/corrupt tail record at offset {off} "
+            f"({len(data) - off} trailing bytes ignored); replay stops "
+            "at the last valid record\n"
+        )

@@ -144,6 +144,7 @@ def run_e2e(
     workload: str = "simple",
     driver: str = "python",
     trace: str | None = None,
+    cdc_slow_us: int | None = None,
     log=None,
 ) -> dict:
     """Format, start a real replica, drive the protocol, return metrics.
@@ -188,13 +189,24 @@ def run_e2e(
     # merge them into one Perfetto-loadable file.
     server_trace = os.path.join(tmpdir, "server_trace.json") if trace else None
     trace_args = ("--trace", server_trace) if server_trace else ()
+    # CDC A/B mode: a live change-stream pump with a deliberately slow
+    # (non-blocking, refusing) sink — the acceptance run proving the live
+    # tail backpressures the PUMP and never the commit path. The server's
+    # [stats] registry snapshot carries cdc.lag_ops /
+    # cdc.backpressure_pauses back out.
+    cdc_args: tuple[str, ...] = ()
+    if cdc_slow_us is not None:
+        cdc_args = (
+            "--cdc-jsonl", os.path.join(tmpdir, "cdc.jsonl"),
+            "--cdc-slow-us", str(cdc_slow_us),
+        )
     proc = subprocess.Popen(
         [sys.executable, "-m", "tigerbeetle_tpu", "start",
          "--addresses", f"127.0.0.1:{port}",
          "--account-slots-log2", str(acct_log2),
          "--transfer-slots-log2", str(slots_log2),
          "--backend", backend,
-         *trace_args, *server_args, path],
+         *trace_args, *cdc_args, *server_args, path],
         cwd=REPO, env=env, start_new_session=True,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
@@ -270,6 +282,17 @@ def run_e2e(
                 # histogram percentiles) — sourced from the same store as
                 # the loop/group numbers above
                 result["server_metrics"] = server_stats["metrics"]
+                if cdc_slow_us is not None:
+                    m = server_stats["metrics"]
+                    result["cdc_lag_ops"] = m.get("gauges", {}).get(
+                        "cdc.lag_ops"
+                    )
+                    result["cdc_backpressure_pauses"] = m.get(
+                        "counters", {}
+                    ).get("cdc.backpressure_pauses")
+                    result["cdc_ops_streamed"] = m.get(
+                        "counters", {}
+                    ).get("cdc.ops")
             if "device_shadow" in server_stats:
                 result["device_shadow"] = server_stats["device_shadow"]
                 sh = server_stats["device_shadow"].get("shadow") or {}

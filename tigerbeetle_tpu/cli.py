@@ -48,6 +48,18 @@ class StartArgs:
     transfer_slots_log2: int = 24
     aof: str = ""  # append-only disaster-recovery log path
     statsd: str = ""  # statsd host | :port | host:port (batched emission)
+    # Change-data-capture (tigerbeetle_tpu/cdc): attach a live CdcPump
+    # tailing this replica's committed ops into a JSONL file and/or UDP
+    # datagrams. The pump rides the event loop with a bounded per-turn
+    # budget and pauses (never the replica) when the sink refuses.
+    cdc_jsonl: str = ""  # change-stream JSONL path
+    cdc_udp: str = ""  # change-stream UDP host | :port | host:port
+    cdc_cursor: str = ""  # cursor file (default: <cdc-jsonl>.cursor)
+    cdc_window: int = 256  # live in-flight window (ops)
+    # Deliberately slow consumer model (bench A/B): the sink accepts at
+    # most one op's records per this many microseconds, REFUSING (not
+    # sleeping) in between — backpressure without blocking the loop.
+    cdc_slow_us: int = 0
     # dump a Chrome trace-event JSON (Perfetto-loadable) of the commit
     # pipeline's spans to this path on shutdown (SIGTERM)
     trace: str = ""
@@ -75,6 +87,21 @@ class StartArgs:
 class ReplArgs:
     addresses: str
     cluster: int = 0
+
+
+@dataclasses.dataclass
+class CdcArgs:
+    """Offline change-stream tool: replay an AOF into a sink, resuming
+    from (and advancing) a durable consumer cursor. The disaster-recovery
+    log is the complete committed history from op 1; result codes are
+    regenerated exactly by replaying each prepare through the scalar
+    oracle (parity-locked with the device engines)."""
+
+    file: str = positional("append-only file (AOF) path")
+    consumer: str = "default"  # cursor namespace
+    cursor: str = ""  # cursor file (default: <aof>.<consumer>.cursor)
+    sink: str = "stdout"  # stdout | jsonl:<path> | udp:host[:port]
+    limit: int = 0  # stop after N ops (0 = to end of log)
 
 
 def _parse_addresses(s: str) -> list[tuple[str, int]]:
@@ -105,6 +132,30 @@ def cmd_format(args) -> int:
     print(f"formatted {args.file}: cluster={args.cluster} "
           f"replica={args.replica}/{args.replica_count}")
     return 0
+
+
+class _FanoutSink:
+    """start --cdc-jsonl + --cdc-udp together: EVERY sink is offered each
+    emission (no short-circuit), and the op counts as delivered only when
+    all accepted. A refusal by one member means the pump retries the op,
+    so sinks that already accepted see it again — at-least-once per sink,
+    dedupable by op like any other redelivery. (Both current members
+    always accept; this matters only for future refusing sinks.)"""
+
+    def __init__(self, sinks):
+        self.sinks = sinks
+
+    def emit_lines(self, lines) -> bool:
+        results = [s.emit_lines(lines) for s in self.sinks]
+        return all(results)
+
+    def flush(self) -> None:
+        for s in self.sinks:
+            s.flush()
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()
 
 
 def _install_parent_death_watchdog() -> None:
@@ -238,6 +289,38 @@ def cmd_start(args) -> int:
         replica.aof = AOF(args.aof)
     replica.commit_window = args.commit_window
     replica.fuse_window_ns = args.fuse_window_us * 1000
+    cdc_pump = None
+    if args.cdc_jsonl or args.cdc_udp:
+        from tigerbeetle_tpu.cdc import (
+            CdcPump,
+            FileCursor,
+            JsonlFileSink,
+            ThrottleSink,
+            UdpSink,
+        )
+
+        sinks = []
+        if args.cdc_jsonl:
+            sinks.append(JsonlFileSink(args.cdc_jsonl))
+        if args.cdc_udp:
+            sinks.append(UdpSink(*parse_addr(args.cdc_udp)))
+        sink = sinks[0] if len(sinks) == 1 else _FanoutSink(sinks)
+        if args.cdc_slow_us:
+            sink = ThrottleSink(sink, args.cdc_slow_us)
+        cursor_path = args.cdc_cursor or (
+            (args.cdc_jsonl or args.file) + ".cursor"
+        )
+        cdc_pump = CdcPump(
+            replica, sink, FileCursor(cursor_path),
+            window=args.cdc_window,
+            # the AOF (when on) is the deep-resume source: ops older than
+            # the WAL ring replay through the oracle with exact results
+            aof_path=args.aof or None,
+        )
+        # attach BEFORE open(): single-replica recovery re-commits the
+        # journal tail, and those redeliveries are exactly what the
+        # cursor dedups — the pump must see them, not miss them
+        cdc_pump.attach()
     statsd = emitter = None
     if args.statsd:
         # accepts `host`, `:port`, and `host:port` (a bare host used to
@@ -313,6 +396,17 @@ def cmd_start(args) -> int:
                     "error": f"{type(e).__name__}: {e}",
                 }
         print(f"[stats] {_json.dumps(stats)}", flush=True)
+        if cdc_pump is not None:
+            # finalize any in-flight commits (their replies are what the
+            # stream encodes), then a bounded final drain + durable
+            # cursor/sink flush — a slow sink must not hold up shutdown
+            try:
+                replica.flush_commits()
+            except Exception:
+                pass  # stream what already finalized
+            cdc_pump.pump(budget_ops=1024)
+            cdc_pump.flush()
+            cdc_pump.sink.close()
         if args.trace:
             tracer.dump(args.trace)
         if emitter is not None:
@@ -343,6 +437,13 @@ def cmd_start(args) -> int:
         # every turn (not only n > 0): same-turn arrivals fuse into a
         # group, and an expired fuse window must dispatch promptly
         replica.pump_commits()
+        if cdc_pump is not None:
+            # bounded change-stream progress OFF the commit path: one op
+            # per turn while the wire is busy (an 8190-record encode is
+            # real host time), a larger bite when idle. Not counted into
+            # loop busy_s — that accounts the commit pipeline the bench's
+            # loop_us_per_batch quotes.
+            cdc_pump.pump(budget_ops=1 if busy else 8)
         if busy:
             loop_stats.add("busy_s", time.monotonic() - t0)
             loop_stats.add("turns")
@@ -387,6 +488,72 @@ def cmd_start(args) -> int:
             )
 
 
+def cmd_cdc(args) -> int:
+    """Replay the AOF's change stream into a sink from the consumer's
+    cursor. One shot: runs to the end of the log (or --limit), acks the
+    cursor, exits — the operator bootstrap/backfill path; live tailing is
+    `start --cdc-jsonl/...`."""
+    from tigerbeetle_tpu.cdc import (
+        AofReplaySource,
+        FileCursor,
+        JsonlFileSink,
+        StdoutSink,
+        UdpSink,
+        encode_batch,
+        gap_record,
+        record_line,
+    )
+    from tigerbeetle_tpu.statsd import parse_addr
+
+    if args.sink == "stdout":
+        sink = StdoutSink()
+    elif args.sink.startswith("jsonl:"):
+        sink = JsonlFileSink(args.sink[len("jsonl:"):])
+    elif args.sink.startswith("udp:"):
+        sink = UdpSink(*parse_addr(args.sink[len("udp:"):]))
+    else:
+        flags.fatal(f"unknown --sink {args.sink!r} "
+                    "(stdout | jsonl:<path> | udp:host[:port])")
+    cursor = FileCursor(
+        args.cursor or f"{args.file}.{args.consumer}.cursor"
+    )
+    acked_op, _ = cursor.load()
+    source = AofReplaySource(args.file)
+    ops = records = 0
+    op = acked_op + 1
+    last = None
+    while not args.limit or ops < args.limit:
+        got = source.read(op)
+        if got is None:
+            # an AOF hole (ops this replica never executed — a state-sync
+            # jump): declare it and continue from where the log resumes
+            resume = source.next_available()
+            if resume is None:
+                break  # end of log
+            if not sink.emit_lines([record_line(gap_record(op, resume - 1))]):
+                break
+            op = resume
+            continue
+        header, body, reply = got
+        recs = encode_batch(header, body, reply)
+        if recs and not sink.emit_lines([record_line(r) for r in recs]):
+            break  # a refusing sink ends the one-shot run; cursor holds
+        records += len(recs)
+        ops += 1
+        last = header
+        op += 1
+    if last is not None:
+        cursor.ack(last.op, last.checksum)
+    sink.flush()
+    sink.close()
+    print(
+        f"cdc: {records} records over {ops} ops "
+        f"(consumer {args.consumer!r}, cursor at op {last.op if last else acked_op})",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def cmd_repl(args) -> int:
     from tigerbeetle_tpu.repl import Repl
 
@@ -402,6 +569,7 @@ commands:
   start    run a replica
   version  print version
   repl     interactive client (alias: client)
+  cdc      replay an AOF's change stream into a sink (cursor resume)
 """
 
 COMMANDS = {
@@ -409,6 +577,7 @@ COMMANDS = {
     "start": (StartArgs, cmd_start),
     "repl": (ReplArgs, cmd_repl),
     "client": (ReplArgs, cmd_repl),
+    "cdc": (CdcArgs, cmd_cdc),
 }
 
 

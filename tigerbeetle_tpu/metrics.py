@@ -381,6 +381,19 @@ CATALOG = {
     "shadow.overlapped": ("counter", "", "groups staged while the previous kernel ran"),
     # device ledger
     "ledger.staging_wait_us": ("histogram", "us", "group staging double-buffer fence waits"),
+    # change-data-capture (tigerbeetle_tpu/cdc/pump.py)
+    "cdc.ops": ("counter", "ops", "committed ops streamed (gap spans excluded)"),
+    "cdc.records": ("counter", "records", "change records accepted by the sink"),
+    "cdc.gap_ops": ("counter", "ops", "ops covered by declared gap records"),
+    "cdc.lag_ops": ("gauge", "ops", "commit_min minus the next un-streamed op"),
+    "cdc.backpressure_pauses": ("counter", "", "pump pauses on a refusing sink (transitions)"),
+    "cdc.live_hits": ("counter", "ops", "ops served from the live hook window"),
+    "cdc.journal_reads": ("counter", "ops", "ops re-read from the WAL ring"),
+    "cdc.aof_reads": ("counter", "ops", "ops replayed from the AOF (oracle-derived results)"),
+    "cdc.results_unknown": ("counter", "ops", "create ops streamed without a reply buffer"),
+    "cdc.resume_forks": ("counter", "", "cursor checksum mismatches detected at resume"),
+    "cdc.cursor_writes": ("counter", "", "durable cursor acks (atomic write-rename)"),
+    "cdc.pump_us": ("histogram", "us", "one bounded pump turn (encode + emit)"),
     # bench driver
     "bench.batch_latency_us": ("histogram", "us", "synced single-batch dispatch latency"),
 }

@@ -46,6 +46,143 @@ CLIENT_ID_BASE = 1 << 64
 CLIENT_RETRY_TICKS = 30
 
 
+class SimCdcConsumer:
+    """Deterministic CDC consumer for the VOPR: tails one replica's
+    committed stream through a REAL CdcPump into a durable store, with a
+    seeded crash/restart schedule for the consumer itself (the subsystem's
+    fault model: the pump and its live window are volatile; the cursor and
+    the downstream store survive, exactly what a process crash leaves).
+
+    Redelivery happens whenever a crash lands between sink-accept and
+    cursor-ack (and whenever the tailed replica itself restarts and
+    re-commits from its checkpoint) — the store dedups at APPLY time by
+    op, which is the at-least-once contract under test: raw_lines may
+    carry duplicates, `stream`/`balances` must not."""
+
+    def __init__(self, sim: "Simulator", index: int, seed: int,
+                 crash_probability: float = 0.01,
+                 restart_ticks_max: int = 40):
+        self.sim = sim
+        self.index = index
+        self.rng = random.Random(seed * 19 + 5)
+        self.crash_probability = crash_probability
+        self.restart_ticks_max = restart_ticks_max
+        from tigerbeetle_tpu.cdc import MemoryCursor
+
+        # durable across consumer crashes
+        self.cursor = MemoryCursor()
+        self.raw_lines: list[str] = []  # as delivered (may hold dups)
+        self.stream: list[str] = []  # deduped applied stream
+        self.applied_ops: list[int] = []
+        self.applied_op = 0
+        self.balances: dict[int, dict[str, int]] = {}
+        self.gaps: list[tuple[int, int]] = []
+        self.redelivered_ops = 0
+        self.crashes = 0
+        # volatile
+        self._pump = None
+        self._down_until: int | None = None
+
+    # -- the durable downstream store, as a sink --
+
+    def emit_lines(self, lines: list[str]) -> bool:
+        """One op's records (the pump emits op-atomically). Apply-once:
+        ops at or below the applied high-water mark are redeliveries and
+        must change nothing."""
+        import json as _json
+
+        self.raw_lines.extend(lines)
+        first = _json.loads(lines[0])
+        if first.get("kind") == "gap":
+            # clip to ops not already applied: a post-crash pump resuming
+            # from the cursor may declare a span overlapping applied-but-
+            # unacked ops — for those this is just redelivery-as-gap (the
+            # store already holds them), not lost history
+            lo = max(first["from"], self.applied_op + 1)
+            if lo <= first["to"]:
+                self.gaps.append((lo, first["to"]))
+                self.stream.extend(lines)
+            else:
+                self.redelivered_ops += 1
+            self.applied_op = max(self.applied_op, first["to"])
+            return True
+        op = first["op"]
+        if op <= self.applied_op:
+            self.redelivered_ops += 1
+            return True  # dedup: accepted, zero effect
+        self.stream.extend(lines)
+        self.applied_ops.append(op)
+        self.applied_op = op
+        for line in lines:
+            rec = _json.loads(line)
+            for account, field, amount in rec.get("deltas", ()):
+                acct = self.balances.setdefault(account, {})
+                acct[field] = acct.get(field, 0) + amount
+        return True
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # -- lifecycle --
+
+    def _attach(self) -> None:
+        from tigerbeetle_tpu.cdc import CdcPump
+
+        self._pump = CdcPump(
+            self.sim.replicas[self.index], self, self.cursor,
+            window=32, ack_interval=4,
+        )
+        self._pump.attach()
+
+    def tick(self, now: int) -> None:
+        if self._down_until is not None:
+            if now < self._down_until:
+                return
+            self._down_until = None
+        if self._pump is not None and self.rng.random() < self.crash_probability:
+            # consumer crash: the pump, its live window, and any progress
+            # past the last cursor ack are gone
+            self.crashes += 1
+            self._pump.detach()
+            self._pump = None
+            self._down_until = now + self.rng.randint(
+                5, self.restart_ticks_max
+            )
+            return
+        if self._pump is None:
+            self._attach()
+        elif self._pump.replica is not self.sim.replicas[self.index]:
+            # the tailed replica restarted: re-subscribe to the new
+            # process (its recovery re-commits redeliver; the store dedups)
+            self._pump.detach()
+            self._attach()
+        if self.index in self.sim.down:
+            return  # tailed replica down: the stream simply stalls
+        self._pump.pump(budget_ops=4)
+
+    def drain(self, budget_turns: int = 2000) -> None:
+        """Post-heal: stream everything committed (no more crashes)."""
+        self.crash_probability = 0.0
+        if self._pump is None or (
+            self._pump.replica is not self.sim.replicas[self.index]
+        ):
+            if self._pump is not None:
+                self._pump.detach()
+            self._attach()
+        r = self.sim.replicas[self.index]
+        for _ in range(budget_turns):
+            self._pump.pump(budget_ops=16)
+            if self._pump.next_op > r.commit_min:
+                return
+        raise AssertionError(
+            f"cdc consumer failed to drain: next_op={self._pump.next_op} "
+            f"commit_min={r.commit_min}"
+        )
+
+
 class SimClient:
     """Workload-driving client with tick-based retries."""
 
@@ -120,10 +257,18 @@ class Simulator:
         client_batch: int = 8,
         workload_knobs: dict | None = None,
         trace_path: str | None = None,
+        cdc_consumer: bool = False,
+        cdc_crash_probability: float = 0.01,
     ):
         from tigerbeetle_tpu.constants import TEST_PROCESS
 
         self.process_config = process or TEST_PROCESS
+        # set BEFORE the replica loop: every replica (including ones
+        # rebuilt by crash/restart) must retain CDC reply bodies from its
+        # first committed op, or a consumer resuming across a tailed-
+        # replica restart reads the WAL with the reply ring empty and
+        # streams result:null records
+        self.cdc_enabled = cdc_consumer
         self.seed = seed
         self.rng = random.Random(seed)
         self.ticks_budget = ticks
@@ -217,6 +362,15 @@ class Simulator:
             for i in range(n_clients)
         ]
 
+        # Deterministic CDC consumer (tigerbeetle_tpu/cdc): tails replica
+        # 0's committed stream, with its own seeded crash/restart
+        # schedule; _check proves no gaps and no duplicated effects.
+        self.cdc = (
+            SimCdcConsumer(self, 0, seed,
+                           crash_probability=cdc_crash_probability)
+            if cdc_consumer else None
+        )
+
     def _make_replica(self, i: int) -> Replica:
         r = Replica(
             i, self.replica_count, self.storages[i], self.net, self.times[i],
@@ -239,6 +393,7 @@ class Simulator:
             )
 
         r.commit_hook = hook
+        r.cdc_retain = self.cdc_enabled  # restarts keep the reply ring on
         # thread timing must not leak into seeded deterministic runs
         r.sync_payload_async = False
         r.open()
@@ -458,6 +613,8 @@ class Simulator:
                     r.tick()
             for c in self.clients:
                 c.tick(now)
+            if self.cdc is not None:
+                self.cdc.tick(now)
             self.net.tick()
 
         try:
@@ -471,10 +628,19 @@ class Simulator:
         committed = max(
             (max(h) if h else 0) for h in self.histories
         )
+        out_cdc = {}
+        if self.cdc is not None:
+            out_cdc = {
+                "cdc_records": len(self.cdc.stream),
+                "cdc_crashes": self.cdc.crashes,
+                "cdc_redelivered_ops": self.cdc.redelivered_ops,
+                "cdc_gaps": len(self.cdc.gaps),
+            }
         return {
             "seed": self.seed,
             "committed_ops": committed,
             "replies": sum(c.replies for c in self.clients),
+            **out_cdc,
             "crashes": self.crashes,
             "wal_faults": self.wal_faults,
             "torn_writes": self.torn_writes,
@@ -491,6 +657,8 @@ class Simulator:
         self.net.options.partition_probability = 0.0
         self.net.options.packet_loss_probability = 0.0
         self.crash_probability = 0.0
+        if self.cdc is not None:
+            self.cdc.crash_probability = 0.0
         for c in self.clients:
             c.drain_mode = True
         for i in list(self.down):
@@ -504,6 +672,8 @@ class Simulator:
                 r.tick()
             for c in self.clients:
                 c.tick(self.net.tick_now)
+            if self.cdc is not None:
+                self.cdc.tick(self.net.tick_now)
             self.net.tick()
             mins = {r.commit_min for r in self.replicas}
             stats = {r.status for r in self.replicas}
@@ -568,6 +738,74 @@ class Simulator:
             assert accounts == oracle.accounts, f"replica {r.replica} accounts"
             assert transfers == oracle.transfers, f"replica {r.replica} transfers"
             assert posted == oracle.posted, f"replica {r.replica} posted"
+
+        if self.cdc is not None:
+            self._check_cdc(merged, top)
+
+    def _check_cdc(self, merged: dict[int, tuple], top: int) -> None:
+        """The change stream's contract, against the god's-eye history:
+
+        - coverage: applied ops + declared gaps tile every record-bearing
+          committed op exactly once (no silent holes, no op both applied
+          and declared gone);
+        - no duplicated effects: the deduped stream must equal, line for
+          line, a reference encoding of the true history (the oracle
+          regenerates exact reply buffers) — a record applied twice, out
+          of order, or with drifted content all fail the same assert;
+        - balance materialization: the consumer's delta-accumulated
+          balances equal the reference's (apply-once proven on the
+          numbers, not just the lines)."""
+        import json as _json
+
+        from tigerbeetle_tpu.cdc.record import encode_batch, record_line
+
+        self.cdc.drain()
+        create_ops = (
+            int(Operation.create_accounts), int(Operation.create_transfers)
+        )
+        gap_ops: set[int] = set()
+        for a, b in self.cdc.gaps:
+            assert 1 <= a <= b <= top, (a, b, top)
+            gap_ops.update(range(a, b + 1))
+        applied = set(self.cdc.applied_ops)
+        assert len(applied) == len(self.cdc.applied_ops), "op applied twice"
+        assert not (applied & gap_ops), "op both applied and declared gone"
+        expected_ops = {
+            op for op in range(1, top + 1)
+            if merged[op][1] in create_ops
+        }
+        assert applied == expected_ops - gap_ops, (
+            "stream coverage hole: "
+            f"missing={sorted(expected_ops - gap_ops - applied)[:8]} "
+            f"extra={sorted(applied - expected_ops)[:8]}"
+        )
+
+        sm = StateMachine(OracleStateMachine(), self.cluster_config)
+        expected_lines: list[str] = []
+        expected_balances: dict[int, dict[str, int]] = {}
+        for op in range(1, top + 1):
+            _, operation, timestamp, body = merged[op]
+            if operation not in create_ops:
+                continue  # registers/lookups: no state change, no records
+            reply = sm.commit(Operation(operation), timestamp, body)
+            if op not in applied:
+                continue  # declared gap: consumer never saw it
+            for rec in encode_batch(
+                Header(op=op, operation=operation, timestamp=timestamp),
+                body, reply,
+            ):
+                expected_lines.append(record_line(rec))
+                for account, field, amount in rec.get("deltas", ()):
+                    acct = expected_balances.setdefault(account, {})
+                    acct[field] = acct.get(field, 0) + amount
+        actual = [
+            line for line in self.cdc.stream
+            if _json.loads(line).get("kind") != "gap"
+        ]
+        assert actual == expected_lines, (
+            f"cdc stream drift: {len(actual)} vs {len(expected_lines)} lines"
+        )
+        assert self.cdc.balances == expected_balances, "duplicated effects"
 
 
 def run_simulation(seed: int, **kwargs) -> dict:

@@ -70,6 +70,13 @@ GRID_SCRUB_TICKS = 8  # forest-block scrub cadence (reference: grid scrubber)
 GRID_SCRUB_BLOCKS = 8  # acquired blocks verified per scrub pass
 WAL_SWEEP_TICKS = 64  # in-place-fault WAL re-verify cadence (1 MiB/pass)
 
+# CDC reply-ring retention: only create-op replies (sparse failure
+# structs) are kept for resume-from-WAL; read replies are large and the
+# change stream encodes no records for reads.
+_CDC_RETAIN_OPS = (
+    int(Operation.create_accounts), int(Operation.create_transfers)
+)
+
 # DVC suffix NACK marker: a synthetic header whose `operation` proves the
 # sender's slot for that op is BLANK — it never prepared the op (the
 # reference's blank header in protocol-aware recovery, src/vsr.zig:302-304).
@@ -240,6 +247,24 @@ class Replica:
         # optional append-only disaster-recovery log (reference: src/aof.zig,
         # hooked before the reply at src/vsr/replica.zig:3643-3648)
         self.aof = None
+        # CDC seam (tigerbeetle_tpu/cdc): cdc_hook(header, body, reply_body)
+        # fires once per op at commit FINALIZE, in op order, with the reply
+        # buffer the replica materialized for the client anyway — the
+        # change-stream pump's live tail (no new d2h, no copies). With
+        # cdc_retain on, the replies of the last journal_slot_count ops are
+        # kept in cdc_replies (tiny: sparse failure structs, usually empty)
+        # so a pump resuming from the WAL ring can rebuild exact records
+        # for ops it missed while down.
+        self.cdc_hook = None
+        self.cdc_retain = False
+        self.cdc_replies: dict[int, bytes] = {}
+        # Finalized-op watermark: with an async commit window, commit_min
+        # advances at DISPATCH while replies materialize at finalize — a
+        # pump bounded by commit_min would race ahead of the hook and
+        # stream ops whose reply buffers don't exist yet. This is the
+        # stream-safe bound: the highest op whose finalize has run (or
+        # that a restore/state-sync declared executed elsewhere).
+        self.cdc_commit_min = 0
 
         # tick + view-change state
         self.ticks = 0
@@ -293,6 +318,7 @@ class Replica:
         self.view = self.log_view = persisted_log_view
         self.checkpoint_op = state.commit_min
         self.commit_min = self.commit_max = self.op = state.commit_min
+        self.cdc_commit_min = state.commit_min  # executed pre-restart
         self.parent_checksum = self.commit_checksum = state.commit_min_checksum
         recovered = self.journal.recover()
         op = state.commit_min + 1
@@ -1303,6 +1329,17 @@ class Replica:
         self._restore_client_replies()
         self.checkpoint_op = new_state.commit_min
         self.commit_min = self.commit_max = self.op = new_state.commit_min
+        # the jumped ops executed elsewhere: unblock the CDC pump (it
+        # declares whatever the journal no longer covers as a gap), and
+        # prune reply-ring entries stranded below the jump — the
+        # single-key eviction at finalize only ever pops op-slot_count
+        # for CONSECUTIVE ops and would skip the jumped range forever
+        self.cdc_commit_min = max(self.cdc_commit_min, new_state.commit_min)
+        if self.cdc_replies:
+            floor = new_state.commit_min - self.cluster.journal_slot_count
+            self.cdc_replies = {
+                k: v for k, v in self.cdc_replies.items() if k > floor
+            }
         self.parent_checksum = self.commit_checksum = new_state.commit_min_checksum
         self._repair_wanted.clear()
         if adopting:
@@ -1626,6 +1663,10 @@ class Replica:
             "handle": handle,
             "reply_body": reply_body,
             "to_client": self.is_primary,
+            # prepare body kept through finalize only for the CDC live
+            # tail (a reference the pipeline/journal holds anyway — but
+            # don't pin 1 MiB per in-flight entry when no pump is on)
+            "body": body if self.cdc_hook is not None else None,
         }
 
     def _commit_finalize(self, entry: dict) -> bytes | None:
@@ -1660,6 +1701,23 @@ class Replica:
         reply.set_checksum()
         if self.reply_hook is not None:
             self.reply_hook(header, reply.checksum_body)
+        if self.cdc_retain:
+            # bounded reply ring for CDC resume-from-WAL: evict the op
+            # that fell out of the journal ring this step. CREATE ops
+            # only — their replies are tiny sparse failure structs; a
+            # lookup's reply is a dense row dump up to message_size_max
+            # that the change stream never reads (no records for reads)
+            if header.operation in _CDC_RETAIN_OPS:
+                self.cdc_replies[header.op] = reply_body
+            self.cdc_replies.pop(
+                header.op - self.cluster.journal_slot_count, None
+            )
+        if self.cdc_hook is not None:
+            # once per op per process (finalize runs once; the dispatch
+            # retry path never reaches here twice), in op order (the
+            # in-flight queue drains FIFO)
+            self.cdc_hook(header, entry.get("body"), reply_body)
+        self.cdc_commit_min = header.op
         wire = reply.to_bytes() + reply_body
         tentry = self.client_table.get(header.client)
         if tentry is not None:
