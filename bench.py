@@ -682,6 +682,28 @@ def bench_e2e(stage, trace: bool = False) -> dict:
     return out
 
 
+def bench_ingress(stage) -> dict:
+    """The ingress_sessions segment: 10k live multiplexed sessions
+    through the gateway (tigerbeetle_tpu/ingress) against one replica —
+    p99 vs the 10-session baseline, plus a deliberately saturating phase
+    whose sheds must not collapse throughput. Host-only (numpy +
+    sockets): runs in the pre-JAX section like the e2e phases."""
+    log = lambda *a: print("[ingress]", *a, file=sys.stderr)  # noqa: E731
+    n = int(os.environ.get("BENCH_INGRESS_SESSIONS", 10_000))
+    try:
+        with stage("ingress_sessions"):
+            from tigerbeetle_tpu.benchmark import run_ingress_sessions
+
+            return run_ingress_sessions(
+                n_sessions=n,
+                conns=int(os.environ.get("BENCH_INGRESS_CONNS", 16)),
+                log=log,
+            )
+    except Exception as e:  # never sink the kernel benchmark
+        print(f"[ingress] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _parse_trace_arg(argv) -> str | None:
     """`--trace <path>` / `--trace=<path>`: dump a merged Chrome
     trace-event JSON (driver spans + the first e2e server's spans) there."""
@@ -716,6 +738,7 @@ def main() -> None:
 
     # E2E first: host-only in this process (subprocess server owns the TPU)
     e2e = bench_e2e(stage, trace=bool(trace_path))
+    ingress = bench_ingress(stage)
 
     import jax
     import jax.numpy as jnp
@@ -982,9 +1005,10 @@ def main() -> None:
     # metrics, server stats, tracked configs — goes to BENCH_DETAIL.json
     # next to this script plus stderr.
     server_trace_events = e2e.pop("trace_events", None)
-    detail = {"durable": e2e, "configs": configs, "stages_s": {
-        k: round(v, 2) for k, v in stages.items()
-    }}
+    detail = {"durable": e2e, "ingress": ingress, "configs": configs,
+              "stages_s": {
+                  k: round(v, 2) for k, v in stages.items()
+              }}
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json")
     with open(detail_path, "w") as f:
@@ -1059,6 +1083,21 @@ def main() -> None:
                         "dispatch_us_post_first_drain"
                     ),
                 ],
+                # ingress gateway: 10k live multiplexed sessions — p99
+                # vs the 10-session baseline (target <= 2x), and the
+                # saturation phase's shed/throughput contract (sheds in
+                # ingress.shed, event tps holds vs unshedded)
+                "ingress_sessions": ingress.get("sessions", 0),
+                "ingress_p99_ms": [
+                    ingress.get("p99_baseline_ms"),
+                    ingress.get("p99_live_ms"),
+                ],
+                "ingress_p99_ratio": ingress.get("p99_ratio"),
+                "ingress_tps_saturated_ratio": ingress.get(
+                    "tps_saturated_ratio"
+                ),
+                "ingress_shed": ingress.get("ingress_shed"),
+                "ingress_busy_replies": ingress.get("busy_replies"),
             }
         )
     )

@@ -32,12 +32,14 @@ from tigerbeetle_tpu.testing.simulator import (  # noqa: E402
 
 VERIFY_FRACTION_DEFAULT = 0.25
 CDC_FRACTION_DEFAULT = 0.2
+INGRESS_FRACTION_DEFAULT = 0.15
 
 
 def run_seed(seed: int, ticks: int, device_fraction: float,
              fixed: bool,
              verify_fraction: float = VERIFY_FRACTION_DEFAULT,
              cdc_fraction: float = CDC_FRACTION_DEFAULT,
+             ingress_fraction: float = INGRESS_FRACTION_DEFAULT,
              trace_path: str | None = None,
              ) -> tuple[dict | None, str, str | None]:
     """(stats, topology-line, error) for one seed. A `verify_fraction`
@@ -46,7 +48,10 @@ def run_seed(seed: int, ticks: int, device_fraction: float,
     re-checks at commit, LSM level audits, journal read-after-write,
     oracle conservation audits. A `cdc_fraction` slice runs the
     deterministic CDC consumer (crash/restart schedule seeded, checker
-    proves no gaps / no duplicated effects)."""
+    proves no gaps / no duplicated effects). An `ingress_fraction` slice
+    runs the ingress gateway on every replica (busy-shed admission), a
+    seeded connect storm, and the 3-consumer CDC fan-out hub with one
+    throttled consumer (backpressure isolation under the fault mix)."""
     from tigerbeetle_tpu import constants
 
     if fixed:
@@ -58,10 +63,19 @@ def run_seed(seed: int, ticks: int, device_fraction: float,
         verify = (seed * 2654435761 % 100) < verify_fraction * 100
         # a distinct multiplier decorrelates the CDC draw from VERIFY's
         cdc = (seed * 2246822519 % 100) < cdc_fraction * 100
+        # ...and a third (FNV prime) decorrelates the ingress slice
+        ingress = (seed * 2166136261 % 100) < ingress_fraction * 100
         desc = describe_options(opts) + (" VERIFY" if verify else "")
         if cdc:
             desc += " CDC"
             opts["cdc_consumer"] = True
+        if ingress and opts.get("backend_factory", "x") is not None:
+            # oracle seeds only: the device slice's tick budget is too
+            # tight for storm registrations + fan-out draining
+            desc += " INGRESS"
+            opts["ingress_gateway"] = True
+            opts["storm_clients"] = 4 + seed % 8
+            opts["cdc_fanout"] = 3
     kw = {"ticks": ticks, **opts}
     if trace_path is not None:
         # deterministic tick-stamped trace (tracer.SimTracer): the same
@@ -97,6 +111,11 @@ def main() -> int:
                     default=CDC_FRACTION_DEFAULT,
                     help="fraction of seeds run with the deterministic "
                          "CDC consumer (crash/restart + stream checker)")
+    ap.add_argument("--ingress-fraction", type=float,
+                    default=INGRESS_FRACTION_DEFAULT,
+                    help="fraction of seeds run with the ingress gateway, "
+                         "a seeded connect storm, and the CDC fan-out hub "
+                         "(throttled-consumer isolation)")
     ap.add_argument("--fixed", action="store_true",
                     help="legacy fixed topology (3 replicas / 2 clients)")
     ap.add_argument("--json", default=None,
@@ -115,6 +134,7 @@ def main() -> int:
             seed, args.ticks, args.device_fraction, args.fixed,
             verify_fraction=args.verify_fraction,
             cdc_fraction=args.cdc_fraction,
+            ingress_fraction=args.ingress_fraction,
             trace_path=(
                 f"{args.trace}.{seed}.json" if args.trace else None
             ),
@@ -139,6 +159,7 @@ def main() -> int:
                    # reproducible if the defaults ever change
                    "verify_fraction": args.verify_fraction,
                    "cdc_fraction": args.cdc_fraction,
+                   "ingress_fraction": args.ingress_fraction,
                    "fixed": args.fixed, "ok": err is None}
             rec["error" if err else "stats"] = err or stats
             sink.write(json.dumps(rec) + "\n")
@@ -155,6 +176,8 @@ def main() -> int:
             extra += f" --verify-fraction {args.verify_fraction}"
         if args.cdc_fraction != CDC_FRACTION_DEFAULT:
             extra += f" --cdc-fraction {args.cdc_fraction}"
+        if args.ingress_fraction != INGRESS_FRACTION_DEFAULT:
+            extra += f" --ingress-fraction {args.ingress_fraction}"
         if args.fixed:
             extra += " --fixed"
         print("replay failures with: python scripts/vopr.py "

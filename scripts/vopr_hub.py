@@ -63,6 +63,7 @@ def replay(rec: dict) -> tuple[bool, str | None]:
     device_fraction/fixed, not the seed alone)."""
     from scripts.vopr import (
         CDC_FRACTION_DEFAULT,
+        INGRESS_FRACTION_DEFAULT,
         VERIFY_FRACTION_DEFAULT,
         run_seed,
     )
@@ -77,6 +78,9 @@ def replay(rec: dict) -> tuple[bool, str | None]:
             "verify_fraction", VERIFY_FRACTION_DEFAULT
         ),
         cdc_fraction=rec.get("cdc_fraction", CDC_FRACTION_DEFAULT),
+        ingress_fraction=rec.get(
+            "ingress_fraction", INGRESS_FRACTION_DEFAULT
+        ),
     )
     return err is not None, err
 

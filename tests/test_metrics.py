@@ -392,3 +392,80 @@ def test_cdc_metric_names_all_cataloged():
         kind, unit, help_ = CATALOG[name]
         assert kind in ("counter", "gauge", "histogram")
         assert isinstance(unit, str) and help_
+
+
+# -- ingress metric names are cataloged (units included) ---------------
+
+
+def test_ingress_metric_names_all_cataloged():
+    """Every metric the ingress gateway + fan-out hub create must be in
+    metrics.CATALOG (the name-coverage contract the cdc.* test enforces,
+    extended to the ingress.* section). The bus-side ingress names
+    (accepts, shed_conn, shed_pool, disconnect_wedged) are asserted
+    statically — they are emitted by the TCP front door, which this
+    in-process run does not exercise."""
+    import numpy as np
+
+    from tigerbeetle_tpu import types
+    from tigerbeetle_tpu.cdc import MemoryCursor, MemorySink
+    from tigerbeetle_tpu.ingress import CdcFanoutHub, IngressGateway
+    from tigerbeetle_tpu.metrics import CATALOG, Metrics
+    from tigerbeetle_tpu.models.oracle import OracleStateMachine
+    from tigerbeetle_tpu.testing.cluster import Cluster
+    from tigerbeetle_tpu.types import Operation
+
+    m = Metrics()
+    cluster = Cluster(
+        replica_count=1, backend_factory=OracleStateMachine, metrics=m
+    )
+    r = cluster.replicas[0]
+    gw = IngressGateway(cluster.network, r, sessions_max=4)
+    gw.install()
+    hub = CdcFanoutHub(r, window=16)
+    hub.add_consumer("a", MemorySink(), MemoryCursor())
+    hub.add_consumer("b", MemorySink(), MemoryCursor())
+    hub.attach()
+    client = cluster.add_client()
+    acct = np.zeros(2, dtype=types.ACCOUNT_DTYPE)
+    acct["id_lo"] = [1, 2]
+    acct["ledger"] = 1
+    acct["code"] = 1
+    cluster.execute(client, Operation.create_accounts, acct.tobytes())
+    hub.pump(budget_ops=16)
+    # exercise the shed + retransmit counters too
+    orig = r.ingress_occupancy
+    r.ingress_occupancy = lambda: (99, 8)
+    gw.regulator.drain()
+    t = np.zeros(1, dtype=types.TRANSFER_DTYPE)
+    t["id_lo"] = 9
+    t["debit_account_id_lo"] = 1
+    t["credit_account_id_lo"] = 2
+    t["amount_lo"] = 1
+    t["ledger"] = 1
+    t["code"] = 1
+    client.request(Operation.create_transfers, t.tobytes())
+    cluster.network.run()
+    r.ingress_occupancy = orig
+    gw.regulator.drain()
+    client.resend()
+    cluster.network.run()
+    client.take_reply()
+
+    snap = m.snapshot()
+    ingress_names = {
+        n
+        for section in ("counters", "gauges", "histograms")
+        for n in snap[section]
+        if n.startswith("ingress.")
+    }
+    assert ingress_names  # the gateway + hub really reported here
+    missing = ingress_names - set(CATALOG)
+    assert not missing, f"ingress metrics missing from CATALOG: {missing}"
+    # the TCP front door's names (not exercised in-process) are cataloged
+    for name in ("ingress.accepts", "ingress.shed_conn",
+                 "ingress.shed_pool", "ingress.disconnect_wedged"):
+        assert name in CATALOG, name
+    for name in ingress_names | {"ingress.accepts"}:
+        kind, unit, help_ = CATALOG[name]
+        assert kind in ("counter", "gauge", "histogram")
+        assert isinstance(unit, str) and help_

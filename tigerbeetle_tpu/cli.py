@@ -36,6 +36,14 @@ class FormatArgs:
     replica: int = 0
     replica_count: int = 1
     grid_mb: int = 64
+    # Session capacity (consensus-affecting: part of the config
+    # fingerprint, so format and start must agree). clients_max is the
+    # replicated client-table cap; client_reply_slots caps the DURABLE
+    # reply slots separately (each costs message_size_max on disk —
+    # 10k+ multiplexed sessions cannot each own one; 0 = one per
+    # client, the pre-ingress layout).
+    clients_max: int = 32
+    client_reply_slots: int = 0
 
 
 @dataclasses.dataclass
@@ -81,6 +89,24 @@ class StartArgs:
     # jax.sharding.Mesh (parallel/mesh.py; slots flags are PER SHARD).
     backend: str = "native"
     shards: int = 0  # sharded backend: devices in the mesh (0 = all)
+    # Session capacity — MUST match the values the data file was
+    # formatted with (config fingerprint; see FormatArgs).
+    clients_max: int = 32
+    client_reply_slots: int = 0
+    # Ingress gateway (tigerbeetle_tpu/ingress): session-multiplexed
+    # admission front door. --ingress installs the gateway (credit-based
+    # admission fed by pipeline occupancy + pool budget; saturated
+    # requests get a typed busy reply instead of queueing or dropping).
+    ingress: bool = False
+    ingress_sessions_max: int = 0  # gateway session-table cap (0 = uncapped)
+    ingress_backlog: int = 1024  # TCP listen backlog (accept-drain loop)
+    ingress_accept_budget: int = 256  # accepts drained per readiness event
+    ingress_dispatch_budget: int = 256  # frames per connection per pump turn
+    # CDC fan-out: with BOTH --cdc-jsonl and --cdc-udp, give each sink
+    # its own consumer (cursor + position) over one shared tail — a slow
+    # sink pauses only itself (ingress/fanout.py). Default keeps the
+    # PR-4 behavior: one pump, one cursor, all sinks move together.
+    cdc_fanout: bool = False
 
 
 @dataclasses.dataclass
@@ -123,7 +149,11 @@ def cmd_format(args) -> int:
     from tigerbeetle_tpu.constants import ConfigCluster
     from tigerbeetle_tpu.vsr.durable import format_data_file
 
-    cluster_cfg = ConfigCluster(replica_count=args.replica_count)
+    cluster_cfg = ConfigCluster(
+        replica_count=args.replica_count,
+        clients_max=args.clients_max,
+        client_reply_slots=args.client_reply_slots,
+    )
     storage = _storage(args.file, cluster_cfg, create=True, grid_mb=args.grid_mb)
     format_data_file(
         storage, cluster_cfg, cluster_id=args.cluster, replica=args.replica
@@ -222,7 +252,11 @@ def cmd_start(args) -> int:
     tracer = JsonTracer(metrics=metrics) if args.trace else Tracer()
 
     addresses = _parse_addresses(args.addresses)
-    cluster_cfg = ConfigCluster(replica_count=len(addresses))
+    cluster_cfg = ConfigCluster(
+        replica_count=len(addresses),
+        clients_max=args.clients_max,
+        client_reply_slots=args.client_reply_slots,
+    )
     process_cfg = ConfigProcess(
         account_slots_log2=args.account_slots_log2,
         transfer_slots_log2=args.transfer_slots_log2,
@@ -230,7 +264,12 @@ def cmd_start(args) -> int:
     boot("imports done")
     storage = _storage(args.file, cluster_cfg, create=False, grid_mb=args.grid_mb)
     boot("storage open")
-    bus = TCPMessageBus(addresses, args.replica, listen=True)
+    bus = TCPMessageBus(
+        addresses, args.replica, listen=True,
+        listen_backlog=args.ingress_backlog,
+        accept_budget=args.ingress_accept_budget,
+        dispatch_budget=args.ingress_dispatch_budget,
+    )
     bus.metrics = metrics
     bus.tracer = tracer
     boot("bus bound")  # must not contain "listening": spawners match on it
@@ -299,24 +338,47 @@ def cmd_start(args) -> int:
             UdpSink,
         )
 
-        sinks = []
+        named = []  # (consumer name, sink)
         if args.cdc_jsonl:
-            sinks.append(JsonlFileSink(args.cdc_jsonl))
+            named.append(("jsonl", JsonlFileSink(args.cdc_jsonl)))
         if args.cdc_udp:
-            sinks.append(UdpSink(*parse_addr(args.cdc_udp)))
-        sink = sinks[0] if len(sinks) == 1 else _FanoutSink(sinks)
+            named.append(("udp", UdpSink(*parse_addr(args.cdc_udp))))
         if args.cdc_slow_us:
-            sink = ThrottleSink(sink, args.cdc_slow_us)
-        cursor_path = args.cdc_cursor or (
+            named = [
+                (n, ThrottleSink(s, args.cdc_slow_us)) for n, s in named
+            ]
+        # an explicit --cdc-cursor names the cursor FILE and is used
+        # verbatim (a restart must find the pre-existing cursor); the
+        # fan-out path derives per-consumer files by suffixing it
+        cursor_file = args.cdc_cursor or (
             (args.cdc_jsonl or args.file) + ".cursor"
         )
-        cdc_pump = CdcPump(
-            replica, sink, FileCursor(cursor_path),
-            window=args.cdc_window,
-            # the AOF (when on) is the deep-resume source: ops older than
-            # the WAL ring replay through the oracle with exact results
-            aof_path=args.aof or None,
-        )
+        if args.cdc_fanout and len(named) > 1:
+            # one shared tail, one consumer (cursor + position) PER sink:
+            # a slow sink pauses only itself (ingress/fanout.py)
+            from tigerbeetle_tpu.ingress import CdcFanoutHub
+
+            cdc_pump = CdcFanoutHub(
+                replica, window=args.cdc_window,
+                aof_path=args.aof or None,
+            )
+            for name, sink in named:
+                cdc_pump.add_consumer(
+                    name, sink, FileCursor(f"{cursor_file}.{name}")
+                )
+        else:
+            sink = (
+                named[0][1] if len(named) == 1
+                else _FanoutSink([s for _n, s in named])
+            )
+            cdc_pump = CdcPump(
+                replica, sink, FileCursor(cursor_file),
+                window=args.cdc_window,
+                # the AOF (when on) is the deep-resume source: ops older
+                # than the WAL ring replay through the oracle with exact
+                # results
+                aof_path=args.aof or None,
+            )
         # attach BEFORE open(): single-replica recovery re-commits the
         # journal tail, and those redeliveries are exactly what the
         # cursor dedups — the pump must see them, not miss them
@@ -332,6 +394,14 @@ def cmd_start(args) -> int:
     boot("opening (superblock + snapshot + WAL recovery)")
     replica.open()
     boot("open done")
+    if args.ingress:
+        from tigerbeetle_tpu.ingress import IngressGateway
+
+        gateway = IngressGateway(
+            bus, replica, sessions_max=args.ingress_sessions_max
+        )
+        gateway.install()
+        boot("ingress gateway installed")
     print(
         f"replica {args.replica}/{len(addresses)} listening on "
         f"{addresses[args.replica][0]}:{addresses[args.replica][1]} "
@@ -406,7 +476,10 @@ def cmd_start(args) -> int:
                 pass  # stream what already finalized
             cdc_pump.pump(budget_ops=1024)
             cdc_pump.flush()
-            cdc_pump.sink.close()
+            if hasattr(cdc_pump, "close"):
+                cdc_pump.close()  # fan-out hub: every consumer's sink
+            else:
+                cdc_pump.sink.close()
         if args.trace:
             tracer.dump(args.trace)
         if emitter is not None:

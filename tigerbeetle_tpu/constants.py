@@ -55,6 +55,14 @@ class ConfigCluster:
     message_size_max: int = MESSAGE_SIZE_MAX
     journal_slot_count: int = 1024
     clients_max: int = 32
+    # Durable reply slots (client_replies zone), decoupled from
+    # clients_max for the ingress gateway's many-session mode: each slot
+    # costs message_size_max on disk, so 10k+ multiplexed sessions cannot
+    # each own one. 0 = clients_max (every session gets a slot — the
+    # pre-ingress behavior). Sessions beyond the slot count register with
+    # slot=None: their duplicate requests after a restart fall back to
+    # the reply-lost paths instead of replaying cached reply bytes.
+    client_reply_slots: int = 0
     pipeline_prepare_queue_max: int = 8
     view_change_headers_suffix_max: int = 8 + 1
     quorum_replication_max: int = 3
@@ -66,6 +74,10 @@ class ConfigCluster:
     @property
     def batch_max(self) -> int:
         return (self.message_size_max - HEADER_SIZE) // TRANSFER_SIZE
+
+    @property
+    def reply_slot_count(self) -> int:
+        return self.client_reply_slots or self.clients_max
 
     @property
     def checkpoint_interval(self) -> int:

@@ -14,6 +14,32 @@ Replica-to-replica links: the replica with the LOWER index connects, the
 higher accepts (a deterministic direction avoids duplicate links). Client
 links: clients connect in; the bus learns the client id from the first
 frame and routes replies back over the same connection.
+
+Ingress extensions (tigerbeetle_tpu/ingress — the 10k-session front door):
+
+- **Session multiplexing**: every request frame's client id is aliased to
+  the connection it arrived on, so many logical sessions share one TCP
+  connection and replies route per-session (`conns[client_id] -> conn`).
+  The one-connection-per-client path is the degenerate single-session
+  case (the alias equals the connection's hello peer). Aliases are
+  latest-wins: a session reconnecting on a new connection takes its
+  routing with it.
+- **Fair pumping**: frames dispatched per connection per pump turn are
+  bounded by `dispatch_budget`; leftovers stay buffered and the
+  connection joins the hot list, drained FIRST next turn — one firehose
+  peer cannot starve the rest of the loop. A trickling (slow-loris) peer
+  never forms a frame and costs one bounded recv per readiness event.
+- **Accept drain**: one readiness event accepts up to `accept_budget`
+  pending connections behind a configurable `listen_backlog` — a connect
+  storm of hundreds no longer lands one accept per select round.
+- **Typed shed outcomes**: `send()` returns "sent" | "shed_conn" |
+  "shed_pool" | "unreachable" and counts refusals into the ingress.*
+  metrics instead of dropping silently; pool budget held by a closing
+  connection is always credited back (churned clients cannot leak it).
+- **Slow-peer defense**: a CLIENT connection whose send queue stays at
+  its cap (open socket, never reads) accumulates strikes and is
+  disconnected after `wedged_strikes_max` consecutive refusals —
+  replica links are exempt (VSR owns their retry discipline).
 """
 
 from __future__ import annotations
@@ -26,7 +52,7 @@ import time as _time
 from tigerbeetle_tpu.io.network import Address, Handler, Network
 from tigerbeetle_tpu.metrics import NULL_METRICS
 from tigerbeetle_tpu.tracer import NULL_TRACER
-from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Header
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
 
 MESSAGE_SIZE_MAX_DEFAULT = 1 << 20
 
@@ -35,8 +61,10 @@ class MessagePool:
     """Fixed send-buffer accounting (reference: src/message_pool.zig:18-41
     — the pool is sized exactly from worst-case concurrent use, and
     exhaustion is BACKPRESSURE, not allocation): sends that would exceed
-    the budget are dropped, which is safe for every VSR message class
-    (the protocol retransmits on its timeouts)."""
+    the budget are refused, which is safe for every VSR message class
+    (the protocol retransmits on its timeouts). Exhaustion is a TYPED
+    outcome (the bus counts it in ingress.shed_pool and its send()
+    returns "shed_pool"), never a silent drop."""
 
     def __init__(self, messages_max: int = 64,
                  message_size_max: int = MESSAGE_SIZE_MAX_DEFAULT):
@@ -57,7 +85,10 @@ class MessagePool:
 
 
 class _Conn:
-    __slots__ = ("sock", "peer", "connected", "rbuf", "roff", "wbuf")
+    __slots__ = (
+        "sock", "peer", "connected", "rbuf", "roff", "wbuf",
+        "sessions", "strikes",
+    )
 
     def __init__(self, sock: socket.socket, peer: Address | None = None,
                  connected: bool = True):
@@ -67,13 +98,37 @@ class _Conn:
         self.rbuf = bytearray()
         self.roff = 0  # consumed-frame offset into rbuf (compacted per turn)
         self.wbuf = bytearray()
+        # client ids whose reply routing aliases to this connection
+        # (session multiplexing; empty for replica links)
+        self.sessions: set[Address] = set()
+        # consecutive sends refused at the per-connection cap: the
+        # wedged-consumer disconnect counter (reset on flush progress)
+        self.strikes = 0
 
 
 class TCPMessageBus(Network):
     # observability seams (re-pointed by the composition root; defaults
-    # are the zero-cost no-op backends)
-    metrics = NULL_METRICS
+    # are the zero-cost no-op backends). `metrics` is a property so a
+    # re-point rebinds the hot-path counters ONCE — per-event registry
+    # lookups would tax exactly the overload paths (shed, accept storm)
+    # the counters exist to observe.
     tracer = NULL_TRACER
+    _metrics = NULL_METRICS
+
+    @property
+    def metrics(self):
+        return self._metrics
+
+    @metrics.setter
+    def metrics(self, m):
+        self._metrics = m
+        self._c_shed_conn = m.counter("ingress.shed_conn")
+        self._c_disconnect_wedged = m.counter("ingress.disconnect_wedged")
+        self._c_shed_pool = m.counter("ingress.shed_pool")
+        self._c_accepts = m.counter("ingress.accepts")
+        self._c_flushes = m.counter("bus.flushes")
+        self._c_tx_bytes = m.counter("bus.tx_bytes")
+        self._c_frames = m.counter("bus.frames")
 
     def __init__(
         self,
@@ -82,11 +137,25 @@ class TCPMessageBus(Network):
         listen: bool = False,
         message_size_max: int = MESSAGE_SIZE_MAX_DEFAULT,
         messages_max: int = 64,
+        listen_backlog: int = 1024,
+        accept_budget: int = 256,
+        dispatch_budget: int = 256,
+        wedged_strikes_max: int = 512,
+        demux: bool = False,
     ):
         """addresses: replica index -> (host, port). own_address: our
-        replica index, or our client id (clients don't listen)."""
+        replica index, or our client id (clients don't listen).
+
+        demux=True (client-side session multiplexing): inbound frames
+        dispatch to the handler attached at the frame's CLIENT id, so N
+        logical sessions' Clients share this one bus/connection — each
+        attaches at its own id and sees only its own replies. The
+        default routes everything to handlers[own] (one session per
+        bus, the pre-ingress behavior)."""
+        self.metrics = self._metrics  # bind the no-op counters until re-pointed
         self.addresses = addresses
         self.own = own_address
+        self.demux = demux
         self.message_size_max = message_size_max
         self.pool = MessagePool(messages_max, message_size_max)
         # Per-connection send cap: one wedged peer (open socket, never
@@ -96,16 +165,29 @@ class TCPMessageBus(Network):
         self.conn_send_max = max(
             2, messages_max // max(2, len(addresses))
         ) * message_size_max
+        self.accept_budget = accept_budget
+        self.dispatch_budget = dispatch_budget
+        self.wedged_strikes_max = wedged_strikes_max
         self.sel = selectors.DefaultSelector()
         self.handlers: dict[Address, Handler] = {}
-        self.conns: dict[Address, _Conn] = {}  # peer -> connection
+        self.conns: dict[Address, _Conn] = {}  # peer/session -> connection
+        # identity set of live connections: `conns` holds one entry PER
+        # SESSION under multiplexing, so per-turn sweeps (flush) iterate
+        # this instead of O(sessions) dict values
+        self._links: dict[_Conn, None] = {}
+        # connections with complete frames still buffered after their
+        # dispatch budget ran out — drained first next pump turn
+        self._hot: dict[_Conn, None] = {}
+        # ingress gateway seam: notified of session aliasing and closes
+        # (None when no gateway is installed — the pre-ingress behavior)
+        self.ingress = None
         self.listener: socket.socket | None = None
         if listen:
             host, port = addresses[own_address]
             s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             s.bind((host, port))
-            s.listen(64)
+            s.listen(listen_backlog)
             s.setblocking(False)
             self.listener = s
             self.sel.register(s, selectors.EVENT_READ, ("accept", None))
@@ -122,31 +204,49 @@ class TCPMessageBus(Network):
     # the replica's group-commit fusion.
     FLUSH_EAGER = 1 << 17
 
-    def send(self, src: Address, dst: Address, data: bytes) -> None:
+    def send(self, src: Address, dst: Address, data: bytes) -> str:
+        """Queue `data` for `dst`. Returns the typed outcome: "sent",
+        "shed_conn" (this peer's queue is capped), "shed_pool" (shared
+        budget exhausted — backpressure, the protocol retransmits), or
+        "unreachable". Existing callers may ignore the return value; the
+        shed outcomes are also counted in the ingress.* metrics."""
         conn = self.conns.get(dst)
         if conn is None:
             if dst < len(self.addresses):
                 conn = self._connect(dst)
             if conn is None:
-                return  # unreachable peer: VSR retransmits cover the loss
+                return "unreachable"  # VSR retransmits cover the loss
         if len(conn.wbuf) + len(data) > self.conn_send_max:
             self.pool.dropped += 1
-            return  # this peer is wedged: drop for IT, not for everyone
+            self._c_shed_conn.add()
+            # Wedged-consumer defense: a CLIENT connection pinned at its
+            # cap is not reading. Strikes accumulate per refused send and
+            # reset whenever a flush makes progress; past the limit the
+            # connection is cut (its sessions re-register on reconnect).
+            # Replica links are exempt: consensus owns their retries.
+            if conn.peer is None or conn.peer >= len(self.addresses):
+                conn.strikes += 1
+                if conn.strikes > self.wedged_strikes_max:
+                    self._c_disconnect_wedged.add()
+                    self._close(conn)
+            return "shed_conn"  # drop for THIS peer, not for everyone
         if not self.pool.try_charge(len(data)):
-            return  # pool exhausted: backpressure — VSR retransmits
+            self._c_shed_pool.add()
+            return "shed_pool"  # pool exhausted: backpressure
         conn.wbuf += data
         if len(conn.wbuf) >= self.FLUSH_EAGER:
             self._flush(conn)  # large payloads start on the wire now
+        return "sent"
 
     def flush_pending(self) -> None:
         """Flush every connection's buffered sends (one syscall per conn
         per turn). pump() calls this on entry (so bytes queued between
         pumps never wait out a blocking select) and on exit (so sends
         queued by this turn's handlers leave with it)."""
-        pending = [c for c in self.conns.values() if c.wbuf]
+        pending = [c for c in self._links if c.wbuf]
         if not pending:
             return
-        self.metrics.counter("bus.flushes").add()
+        self._c_flushes.add()
         with self.tracer.span("bus.flush", conns=len(pending)):
             for conn in pending:
                 self._flush(conn)
@@ -169,6 +269,7 @@ class TCPMessageBus(Network):
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn = _Conn(s, peer=replica, connected=(rc == 0))
         self.conns[replica] = conn
+        self._links[conn] = None
         self.sel.register(
             s, selectors.EVENT_READ | selectors.EVENT_WRITE, ("conn", conn)
         )
@@ -188,15 +289,21 @@ class TCPMessageBus(Network):
         return conn
 
     def _accept(self) -> None:
+        """Drain the accept queue: up to accept_budget pending connections
+        per readiness event (a connect storm of hundreds used to land ONE
+        accept per select round and stall for seconds)."""
         assert self.listener is not None
-        try:
-            s, _addr = self.listener.accept()
-        except OSError:
-            return
-        s.setblocking(False)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        conn = _Conn(s)
-        self.sel.register(s, selectors.EVENT_READ, ("conn", conn))
+        for _ in range(self.accept_budget):
+            try:
+                s, _addr = self.listener.accept()
+            except OSError:
+                return
+            s.setblocking(False)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(s)
+            self._links[conn] = None
+            self.sel.register(s, selectors.EVENT_READ, ("conn", conn))
+            self._c_accepts.add()
 
     def _close(self, conn: _Conn) -> None:
         try:
@@ -206,6 +313,18 @@ class TCPMessageBus(Network):
         conn.sock.close()
         self.pool.credit(len(conn.wbuf))  # unsent bytes return to the pool
         conn.wbuf.clear()
+        self._hot.pop(conn, None)
+        self._links.pop(conn, None)
+        # the gateway sees the close FIRST, while conn.sessions still
+        # names the sessions routed here (it drops their table entries)
+        if self.ingress is not None:
+            self.ingress.on_conn_close(conn)
+        # drop every routing entry aliased here (sessions + hello peer):
+        # a reconnect re-learns them from its first frames
+        for cid in conn.sessions:
+            if self.conns.get(cid) is conn:
+                del self.conns[cid]
+        conn.sessions.clear()
         if conn.peer is not None and self.conns.get(conn.peer) is conn:
             del self.conns[conn.peer]
 
@@ -223,17 +342,25 @@ class TCPMessageBus(Network):
             if n <= 0:
                 return
             del conn.wbuf[:n]
+            conn.strikes = 0  # the peer is reading again
             self.pool.credit(n)
-            self.metrics.counter("bus.tx_bytes").add(n)
+            self._c_tx_bytes.add(n)
 
     # -- pumping --
 
     def pump(self, timeout: float = 0.01) -> int:
         """One event-loop turn: accept/read/dispatch. Returns frames
-        dispatched."""
+        dispatched. Hot connections (frames buffered past their budget
+        last turn) are drained FIRST, before the select — fairness is
+        round-robin across turns, not starvation of the patient."""
         dispatched = 0
         t0 = _time.perf_counter_ns() if self.metrics.enabled else 0
         self.flush_pending()  # deferred sends must not wait out the select
+        if self._hot:
+            timeout = 0.0  # buffered work exists: never block the select
+            hot, self._hot = self._hot, {}
+            for conn in hot:
+                dispatched += self._drain(conn)
         for key, mask in self.sel.select(timeout):
             kind, conn = key.data
             if kind == "accept":
@@ -279,18 +406,27 @@ class TCPMessageBus(Network):
         if dispatched and t0:
             # only turns that dispatched frames: idle selects would bury
             # the signal (and cost a histogram write per quiet turn)
-            self.metrics.counter("bus.frames").add(dispatched)
+            self._c_frames.add(dispatched)
             self.metrics.histogram("bus.pump_us").observe(
                 (_time.perf_counter_ns() - t0) / 1000.0
             )
         return dispatched
 
-    # byte offset of the header's size u32: five u128s (80) + four u32s
-    # (16) + three u64s (24); cross-checked against Header at import
+    # Peeked header fields (framing + session aliasing read a handful of
+    # bytes instead of parsing the full header — that parse, and the
+    # checksum, belong to the handler): five u128s (80) + four u32s (16) +
+    # three u64s (24) = size u32 at 120; client u128 at 48 (after
+    # checksum, checksum_body, parent); request u32 at 80; command u8 at
+    # 125. All cross-checked against Header at import.
     _SIZE_OFF = 120
+    _CLIENT_OFF = 48
+    _REQUEST_OFF = 80
+    _CMD_OFF = 125
+    _OP_OFF = 126  # `operation` u8
 
-    def _drain(self, conn: _Conn) -> int:
+    def _drain(self, conn: _Conn, budget: int | None = None) -> int:
         n = 0
+        budget = self.dispatch_budget if budget is None else budget
         buf = conn.rbuf
         # frame-parse span: only when there is at least one parseable
         # frame AND tracing is on (pump calls _drain for every readable
@@ -303,9 +439,11 @@ class TCPMessageBus(Network):
         mv = memoryview(buf)
         try:
             while len(buf) - conn.roff >= HEADER_SIZE:
-                # framing needs only the size field — the full header
-                # parse (and checksum) belongs to the handler; parsing it
-                # here too would double the per-frame header cost
+                if n >= budget:
+                    # fairness: this peer used its turn; remaining frames
+                    # stay buffered and the conn drains first next turn
+                    self._hot[conn] = None
+                    break
                 o = conn.roff + self._SIZE_OFF
                 size = int.from_bytes(mv[o : o + 4], "little")
                 if size < HEADER_SIZE or size > self.message_size_max:
@@ -333,9 +471,40 @@ class TCPMessageBus(Network):
                     # readable.
                     if peer not in self.conns:
                         self.conns[peer] = conn
+                    if header.client:
+                        # the hello peer IS a session (the degenerate
+                        # single-session case): track it like any alias
+                        # so close/gateway bookkeeping is uniform
+                        conn.sessions.add(peer)
+                        if self.ingress is not None:
+                            self.ingress.on_session(peer, conn)
                     if size == HEADER_SIZE and header.command == 0:
                         continue  # pure hello: consume
-                handler = self.handlers.get(self.own)
+                # Session multiplexing: alias every request frame's client
+                # id to this connection so the reply routes back here.
+                # Latest-wins (a reconnecting session's new connection
+                # takes over); the degenerate case — one session whose id
+                # IS the hello peer — is a no-op dict hit.
+                if frame[self._CMD_OFF] == _CMD_REQUEST:
+                    cid = int.from_bytes(
+                        frame[self._CLIENT_OFF : self._CLIENT_OFF + 16],
+                        "little",
+                    )
+                    if cid and self.conns.get(cid) is not conn:
+                        self._alias(cid, conn)
+                if self.demux:
+                    # session-multiplexed client bus: route by the
+                    # frame's client id (replies/busy/eviction all carry
+                    # it), falling back to the bus's own handler
+                    cid = int.from_bytes(
+                        frame[self._CLIENT_OFF : self._CLIENT_OFF + 16],
+                        "little",
+                    )
+                    handler = (
+                        self.handlers.get(cid) or self.handlers.get(self.own)
+                    )
+                else:
+                    handler = self.handlers.get(self.own)
                 if handler is not None:
                     handler(conn.peer, frame)
                     n += 1
@@ -353,15 +522,34 @@ class TCPMessageBus(Network):
             conn.roff = 0
         return n
 
+    def _alias(self, cid: Address, conn: _Conn) -> None:
+        old = self.conns.get(cid)
+        if old is not None and old is not conn:
+            old.sessions.discard(cid)
+        self.conns[cid] = conn
+        conn.sessions.add(cid)
+        if self.ingress is not None:
+            self.ingress.on_session(cid, conn)
 
-# the framing fast path peeks the size field without parsing the header —
-# pin the offset against the Header layout so it can never drift silently
-assert (
-    int.from_bytes(
-        Header(size=0x0BADF00D).to_bytes()[
-            TCPMessageBus._SIZE_OFF : TCPMessageBus._SIZE_OFF + 4
-        ],
-        "little",
-    )
-    == 0x0BADF00D
-)
+
+# the framing/aliasing fast path peeks fields without parsing the header —
+# pin the offsets against the Header layout so they can never drift
+_CMD_REQUEST = int(Command.request)
+_pin = Header(
+    size=0x0BADF00D, client=0x0CAFE, request=0x0D15EA5E,
+    command=int(Command.request), operation=0x42,
+).to_bytes()
+assert int.from_bytes(
+    _pin[TCPMessageBus._SIZE_OFF : TCPMessageBus._SIZE_OFF + 4], "little"
+) == 0x0BADF00D
+assert int.from_bytes(
+    _pin[TCPMessageBus._CLIENT_OFF : TCPMessageBus._CLIENT_OFF + 16],
+    "little",
+) == 0x0CAFE
+assert int.from_bytes(
+    _pin[TCPMessageBus._REQUEST_OFF : TCPMessageBus._REQUEST_OFF + 4],
+    "little",
+) == 0x0D15EA5E
+assert _pin[TCPMessageBus._CMD_OFF] == _CMD_REQUEST
+assert _pin[TCPMessageBus._OP_OFF] == 0x42
+del _pin

@@ -53,7 +53,7 @@ class ZoneLayout:
             Zone.superblock: self.SUPERBLOCK_COPIES * self.SUPERBLOCK_COPY_SIZE,
             Zone.wal_headers: _sector_ceil(slot_count * 128),
             Zone.wal_prepares: slot_count * msg_max,
-            Zone.client_replies: cluster.clients_max * msg_max,
+            Zone.client_replies: cluster.reply_slot_count * msg_max,
             Zone.grid: grid_size,
         }
         self.starts = {}

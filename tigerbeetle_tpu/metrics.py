@@ -394,6 +394,18 @@ CATALOG = {
     "cdc.resume_forks": ("counter", "", "cursor checksum mismatches detected at resume"),
     "cdc.cursor_writes": ("counter", "", "durable cursor acks (atomic write-rename)"),
     "cdc.pump_us": ("histogram", "us", "one bounded pump turn (encode + emit)"),
+    # ingress gateway + bus front door (tigerbeetle_tpu/ingress)
+    "ingress.sessions": ("gauge", "sessions", "live logical sessions in the gateway table"),
+    "ingress.admitted": ("counter", "requests", "requests admitted by the credit regulator"),
+    "ingress.shed": ("counter", "requests", "requests answered with a typed busy reply"),
+    "ingress.shed_sessions": ("counter", "requests", "new sessions shed at the gateway cap"),
+    "ingress.retransmits": ("counter", "requests", "retransmits bypassing admission"),
+    "ingress.accepts": ("counter", "conns", "connections taken by the accept-drain loop"),
+    "ingress.shed_conn": ("counter", "sends", "sends refused at a per-connection queue cap"),
+    "ingress.shed_pool": ("counter", "sends", "sends refused at the shared message-pool budget"),
+    "ingress.disconnect_wedged": ("counter", "conns", "wedged consumers cut at the strike limit"),
+    "ingress.fanout_consumers": ("gauge", "consumers", "CDC fan-out consumers on one tail"),
+    "ingress.fanout_lag_ops": ("gauge", "ops", "slowest fan-out consumer vs the watermark"),
     # bench driver
     "bench.batch_latency_us": ("histogram", "us", "synced single-batch dispatch latency"),
 }

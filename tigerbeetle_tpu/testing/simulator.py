@@ -46,6 +46,43 @@ CLIENT_ID_BASE = 1 << 64
 CLIENT_RETRY_TICKS = 30
 
 
+def _apply_op_lines(store, lines: list[str]) -> bool:
+    """One op's records into a durable consumer store, APPLY-ONCE by op
+    (shared by the single consumer and every fan-out consumer): ops at
+    or below the applied high-water mark are redeliveries and must
+    change nothing; gap records clip to unapplied ops."""
+    import json as _json
+
+    store.raw_lines.extend(lines)
+    first = _json.loads(lines[0])
+    if first.get("kind") == "gap":
+        # clip to ops not already applied: a post-crash pump resuming
+        # from the cursor may declare a span overlapping applied-but-
+        # unacked ops — for those this is just redelivery-as-gap (the
+        # store already holds them), not lost history
+        lo = max(first["from"], store.applied_op + 1)
+        if lo <= first["to"]:
+            store.gaps.append((lo, first["to"]))
+            store.stream.extend(lines)
+        else:
+            store.redelivered_ops += 1
+        store.applied_op = max(store.applied_op, first["to"])
+        return True
+    op = first["op"]
+    if op <= store.applied_op:
+        store.redelivered_ops += 1
+        return True  # dedup: accepted, zero effect
+    store.stream.extend(lines)
+    store.applied_ops.append(op)
+    store.applied_op = op
+    for line in lines:
+        rec = _json.loads(line)
+        for account, field, amount in rec.get("deltas", ()):
+            acct = store.balances.setdefault(account, {})
+            acct[field] = acct.get(field, 0) + amount
+    return True
+
+
 class SimCdcConsumer:
     """Deterministic CDC consumer for the VOPR: tails one replica's
     committed stream through a REAL CdcPump into a durable store, with a
@@ -89,36 +126,7 @@ class SimCdcConsumer:
         """One op's records (the pump emits op-atomically). Apply-once:
         ops at or below the applied high-water mark are redeliveries and
         must change nothing."""
-        import json as _json
-
-        self.raw_lines.extend(lines)
-        first = _json.loads(lines[0])
-        if first.get("kind") == "gap":
-            # clip to ops not already applied: a post-crash pump resuming
-            # from the cursor may declare a span overlapping applied-but-
-            # unacked ops — for those this is just redelivery-as-gap (the
-            # store already holds them), not lost history
-            lo = max(first["from"], self.applied_op + 1)
-            if lo <= first["to"]:
-                self.gaps.append((lo, first["to"]))
-                self.stream.extend(lines)
-            else:
-                self.redelivered_ops += 1
-            self.applied_op = max(self.applied_op, first["to"])
-            return True
-        op = first["op"]
-        if op <= self.applied_op:
-            self.redelivered_ops += 1
-            return True  # dedup: accepted, zero effect
-        self.stream.extend(lines)
-        self.applied_ops.append(op)
-        self.applied_op = op
-        for line in lines:
-            rec = _json.loads(line)
-            for account, field, amount in rec.get("deltas", ()):
-                acct = self.balances.setdefault(account, {})
-                acct[field] = acct.get(field, 0) + amount
-        return True
+        return _apply_op_lines(self, lines)
 
     def flush(self) -> None:
         pass
@@ -179,6 +187,128 @@ class SimCdcConsumer:
                 return
         raise AssertionError(
             f"cdc consumer failed to drain: next_op={self._pump.next_op} "
+            f"commit_min={r.commit_min}"
+        )
+
+
+class _FanoutStore:
+    """One fan-out consumer's durable downstream store (the sink +
+    apply-once dedup of SimCdcConsumer, without its crash schedule).
+    `throttle_every=k` models a slow consumer: every emission except
+    each k-th is REFUSED — count-based, so the refusal pattern is
+    deterministic and tick-independent."""
+
+    def __init__(self, throttle_every: int = 0):
+        self.throttle_every = throttle_every
+        self.raw_lines: list[str] = []
+        self.stream: list[str] = []
+        self.applied_ops: list[int] = []
+        self.applied_op = 0
+        self.balances: dict[int, dict[str, int]] = {}
+        self.gaps: list[tuple[int, int]] = []
+        self.redelivered_ops = 0
+        self.refusals = 0
+        self._attempts = 0
+
+    def emit_lines(self, lines: list[str]) -> bool:
+        if self.throttle_every:
+            self._attempts += 1
+            if self._attempts % self.throttle_every:
+                self.refusals += 1
+                return False
+        return _apply_op_lines(self, lines)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class SimCdcFanout:
+    """N CDC consumers over ONE shared tail (the ingress fan-out hub,
+    tigerbeetle_tpu/ingress/fanout.py) on replica `index`. The LAST
+    consumer is throttled (its sink refuses all but every k-th
+    emission): the backpressure-isolation contract under test is that
+    its lag grows while every other consumer's stays bounded — one slow
+    consumer pauses only its own cursor. Cursors are durable
+    (MemoryCursor), the hub volatile: a tailed-replica restart rebuilds
+    the hub and consumers resume from their cursors (redeliveries
+    dedup, like the single-consumer model)."""
+
+    THROTTLED = "slow"
+
+    def __init__(self, sim: "Simulator", index: int, seed: int,
+                 n_consumers: int, throttle_every: int = 4):
+        assert n_consumers >= 2
+        from tigerbeetle_tpu.cdc import MemoryCursor
+
+        self.sim = sim
+        self.index = index
+        self.n_consumers = n_consumers
+        self.throttle_every = throttle_every
+        self.stores: dict[str, _FanoutStore] = {}
+        self.cursors: dict[str, MemoryCursor] = {}
+        for i in range(n_consumers - 1):
+            self.stores[f"c{i}"] = _FanoutStore()
+            self.cursors[f"c{i}"] = MemoryCursor()
+        self.stores[self.THROTTLED] = _FanoutStore(
+            throttle_every=throttle_every
+        )
+        self.cursors[self.THROTTLED] = MemoryCursor()
+        self.lag_max: dict[str, int] = {n: 0 for n in self.stores}
+        self.hub = None
+
+    def _attach(self) -> None:
+        from tigerbeetle_tpu.ingress import CdcFanoutHub
+
+        aof = getattr(self.sim, "_fanout_aof", None)
+        self.hub = CdcFanoutHub(
+            self.sim.replicas[self.index], window=32,
+            aof_path=aof.name if aof is not None else None,
+        )
+        for name, store in self.stores.items():
+            self.hub.add_consumer(
+                name, store, self.cursors[name], ack_interval=4
+            )
+        self.hub.attach()
+
+    def tick(self, now: int) -> None:
+        if self.hub is None:
+            self._attach()
+        elif self.hub.replica is not self.sim.replicas[self.index]:
+            # the tailed replica restarted: re-subscribe; consumers
+            # resume from their durable cursors (redeliveries dedup)
+            self.hub.detach()
+            self._attach()
+        if self.index in self.sim.down:
+            return  # tailed replica down: every consumer stalls
+        self.hub.pump(budget_ops=4)
+        for name, lag in self.hub.lag_ops().items():
+            self.lag_max[name] = max(self.lag_max[name], lag)
+
+    def drain(self, budget_turns: int | None = None) -> None:
+        """Post-heal: every consumer streams to the committed head (the
+        throttled sink accepts one emission per `throttle_every`
+        attempts, so the budget scales with the op count)."""
+        if self.hub is None or (
+            self.hub.replica is not self.sim.replicas[self.index]
+        ):
+            if self.hub is not None:
+                self.hub.detach()
+            self._attach()
+        r = self.sim.replicas[self.index]
+        if budget_turns is None:
+            budget_turns = 2000 + (self.throttle_every + 1) * r.commit_min
+        for _ in range(budget_turns):
+            self.hub.pump(budget_ops=16)
+            if all(
+                p.next_op > r.commit_min for p in self.hub.pumps.values()
+            ):
+                return
+        raise AssertionError(
+            "cdc fan-out failed to drain: "
+            f"{[(n, p.next_op) for n, p in self.hub.pumps.items()]} "
             f"commit_min={r.commit_min}"
         )
 
@@ -259,6 +389,10 @@ class Simulator:
         trace_path: str | None = None,
         cdc_consumer: bool = False,
         cdc_crash_probability: float = 0.01,
+        cdc_fanout: int = 0,
+        cdc_fanout_throttle: int = 4,
+        ingress_gateway: bool = False,
+        storm_clients: int = 0,
     ):
         from tigerbeetle_tpu.constants import TEST_PROCESS
 
@@ -268,7 +402,23 @@ class Simulator:
         # first committed op, or a consumer resuming across a tailed-
         # replica restart reads the WAL with the reply ring empty and
         # streams result:null records
-        self.cdc_enabled = cdc_consumer
+        self.cdc_enabled = cdc_consumer or cdc_fanout > 0
+        # Fan-out mode's AOF (see the cdc_fanout block below) — created
+        # BEFORE the replica loop so replica 0 appends from op 1.
+        self._fanout_aof = None
+        if cdc_fanout:
+            import tempfile
+
+            self._fanout_aof = tempfile.NamedTemporaryFile(
+                prefix="tb_sim_aof_", suffix=".aof", delete=False
+            )
+            self._fanout_aof.close()
+        # Ingress gateway on every replica (tigerbeetle_tpu/ingress):
+        # request admission through the credit regulator, saturated
+        # requests answered with typed busy replies the seeded clients
+        # retry through — set before the replica loop so restarted
+        # replicas get their gateway back too.
+        self.ingress_gateway = ingress_gateway
         self.seed = seed
         self.rng = random.Random(seed)
         self.ticks_budget = ticks
@@ -371,6 +521,34 @@ class Simulator:
             if cdc_consumer else None
         )
 
+        # CDC fan-out: N consumers (one deliberately throttled) over ONE
+        # shared tail on replica 0 — the ingress hub's backpressure-
+        # isolation contract under the full fault mix. The tailed
+        # replica writes an AOF (a real temp file; content is
+        # deterministic in the seed): the throttled consumer lags past
+        # the bounded reply-retention ring BY DESIGN, and the AOF-oracle
+        # replay is the source that keeps its deep reads carrying EXACT
+        # result codes — without it those ops would stream result:null
+        # (the documented results_unknown degradation).
+        self.cdc_fanout = (
+            SimCdcFanout(self, 0, seed, cdc_fanout,
+                         throttle_every=cdc_fanout_throttle)
+            if cdc_fanout else None
+        )
+
+        # Connect storm: at a seed-drawn tick, `storm_clients` NEW
+        # sessions register at once (every register is a consensus op
+        # through admission) and then join the workload.
+        self.storm_clients = storm_clients
+        self.storm_tick = (
+            self.rng.randint(ticks // 4, max(ticks // 2, ticks // 4 + 1))
+            if storm_clients else None
+        )
+        self._storm_seed = seed
+        self._n_clients = n_clients
+        self._client_batch = client_batch
+        self._workload_knobs = workload_knobs
+
     def _make_replica(self, i: int) -> Replica:
         r = Replica(
             i, self.replica_count, self.storages[i], self.net, self.times[i],
@@ -394,9 +572,20 @@ class Simulator:
 
         r.commit_hook = hook
         r.cdc_retain = self.cdc_enabled  # restarts keep the reply ring on
+        if i == 0 and getattr(self, "_fanout_aof", None) is not None:
+            # the fan-out tail's deep-resume source; reopened append-only
+            # across restarts (recovery re-commits append duplicates the
+            # replay source skips — the PR-4 torn/duplicate contract)
+            from tigerbeetle_tpu.aof import AOF
+
+            r.aof = AOF(self._fanout_aof.name)
         # thread timing must not leak into seeded deterministic runs
         r.sync_payload_async = False
         r.open()
+        if self.ingress_gateway:
+            from tigerbeetle_tpu.ingress import IngressGateway
+
+            IngressGateway(self.net, r).install()
         return r
 
     # -- fault scheduling --
@@ -523,7 +712,7 @@ class Simulator:
         """Corrupt one client_replies slot: the checksum-validated restore
         must read it as absent and fall back to the reply-lost paths
         (reference: src/testing/storage.zig faults every zone)."""
-        slot = self.rng.randrange(self.cluster_config.clients_max)
+        slot = self.rng.randrange(self.cluster_config.reply_slot_count)
         self.storages[i].fault(
             Zone.client_replies,
             slot * self.cluster_config.message_size_max
@@ -611,10 +800,25 @@ class Simulator:
                 if i not in self.down:
                     self.times[i].tick()
                     r.tick()
+            if self.storm_tick is not None and now >= self.storm_tick:
+                self.storm_tick = None
+                base = len(self.clients)
+                for i in range(self.storm_clients):
+                    self.clients.append(SimClient(
+                        Client(
+                            CLIENT_ID_BASE + base + i, self.net,
+                            self.replica_count,
+                        ),
+                        self._storm_seed * 7 + base + i,
+                        batch_size=self._client_batch,
+                        workload_knobs=self._workload_knobs,
+                    ))
             for c in self.clients:
                 c.tick(now)
             if self.cdc is not None:
                 self.cdc.tick(now)
+            if self.cdc_fanout is not None:
+                self.cdc_fanout.tick(now)
             self.net.tick()
 
         try:
@@ -625,6 +829,13 @@ class Simulator:
             # exactly the artifact worth diffing against a healthy replay
             if self.tracer is not None and self.trace_path is not None:
                 self.tracer.dump(self.trace_path)
+            if self._fanout_aof is not None:
+                import os as _os
+
+                try:
+                    _os.unlink(self._fanout_aof.name)
+                except OSError:
+                    pass
         committed = max(
             (max(h) if h else 0) for h in self.histories
         )
@@ -636,6 +847,14 @@ class Simulator:
                 "cdc_redelivered_ops": self.cdc.redelivered_ops,
                 "cdc_gaps": len(self.cdc.gaps),
             }
+        if self.cdc_fanout is not None:
+            out_cdc["cdc_fanout_consumers"] = self.cdc_fanout.n_consumers
+            out_cdc["cdc_fanout_lag_max"] = dict(self.cdc_fanout.lag_max)
+            out_cdc["cdc_fanout_refusals"] = self.cdc_fanout.stores[
+                SimCdcFanout.THROTTLED
+            ].refusals
+        if self.storm_clients:
+            out_cdc["storm_clients"] = self.storm_clients
         return {
             "seed": self.seed,
             "committed_ops": committed,
@@ -674,6 +893,8 @@ class Simulator:
                 c.tick(self.net.tick_now)
             if self.cdc is not None:
                 self.cdc.tick(self.net.tick_now)
+            if self.cdc_fanout is not None:
+                self.cdc_fanout.tick(self.net.tick_now)
             self.net.tick()
             mins = {r.commit_min for r in self.replicas}
             stats = {r.status for r in self.replicas}
@@ -740,10 +961,18 @@ class Simulator:
             assert posted == oracle.posted, f"replica {r.replica} posted"
 
         if self.cdc is not None:
-            self._check_cdc(merged, top)
+            self.cdc.drain()
+            self._check_cdc_store(self.cdc, merged, top)
+        if self.cdc_fanout is not None:
+            # EVERY consumer of the shared tail owes the full stream
+            # contract independently — including the throttled one
+            self.cdc_fanout.drain()
+            for store in self.cdc_fanout.stores.values():
+                self._check_cdc_store(store, merged, top)
 
-    def _check_cdc(self, merged: dict[int, tuple], top: int) -> None:
-        """The change stream's contract, against the god's-eye history:
+    def _check_cdc_store(self, store, merged: dict[int, tuple],
+                         top: int) -> None:
+        """One consumer store's contract, against the god's-eye history:
 
         - coverage: applied ops + declared gaps tile every record-bearing
           committed op exactly once (no silent holes, no op both applied
@@ -759,16 +988,15 @@ class Simulator:
 
         from tigerbeetle_tpu.cdc.record import encode_batch, record_line
 
-        self.cdc.drain()
         create_ops = (
             int(Operation.create_accounts), int(Operation.create_transfers)
         )
         gap_ops: set[int] = set()
-        for a, b in self.cdc.gaps:
+        for a, b in store.gaps:
             assert 1 <= a <= b <= top, (a, b, top)
             gap_ops.update(range(a, b + 1))
-        applied = set(self.cdc.applied_ops)
-        assert len(applied) == len(self.cdc.applied_ops), "op applied twice"
+        applied = set(store.applied_ops)
+        assert len(applied) == len(store.applied_ops), "op applied twice"
         assert not (applied & gap_ops), "op both applied and declared gone"
         expected_ops = {
             op for op in range(1, top + 1)
@@ -799,13 +1027,13 @@ class Simulator:
                     acct = expected_balances.setdefault(account, {})
                     acct[field] = acct.get(field, 0) + amount
         actual = [
-            line for line in self.cdc.stream
+            line for line in store.stream
             if _json.loads(line).get("kind") != "gap"
         ]
         assert actual == expected_lines, (
             f"cdc stream drift: {len(actual)} vs {len(expected_lines)} lines"
         )
-        assert self.cdc.balances == expected_balances, "duplicated effects"
+        assert store.balances == expected_balances, "duplicated effects"
 
 
 def run_simulation(seed: int, **kwargs) -> dict:

@@ -26,6 +26,11 @@ class Client:
         self.reply: tuple[Header, bytes] | None = None
         self.evicted = False
         self.in_flight: bytes | None = None
+        # Load-shed signal (Command.busy from the ingress gateway): the
+        # in-flight request was REFUSED, not lost — the driver should back
+        # off and resend() instead of waiting out the full retry timeout.
+        self.busy = False
+        self.busy_replies = 0
         network.attach(client_id, self._on_message)
 
     @property
@@ -42,12 +47,28 @@ class Client:
         if header.command == Command.eviction:
             self.evicted = True
             return
+        if header.command == Command.busy:
+            # the gateway shed the CURRENT request: keep it in flight so
+            # resend() retries the same bytes after the driver's backoff
+            if header.request == self.request_number and self.in_flight is not None:
+                self.busy = True
+                self.busy_replies += 1
+            return
         if header.command != Command.reply:
+            return
+        if self.in_flight is None:
+            # nothing awaiting: a duplicate of an already-taken reply.
+            # Register replies in particular always carry request=0 and
+            # request_number stays 0 after registration, so a late
+            # duplicate (a shed-then-retried register racing the cached
+            # resend) would otherwise be accepted and sit in `reply` to
+            # be misread as the answer to the NEXT request.
             return
         if header.request != self.request_number:
             return  # stale reply
         self.view = max(self.view, header.view)
         self.in_flight = None
+        self.busy = False
         self.reply = (header, body)
 
     # -- requests (the pump is external: network.run()) --
@@ -92,6 +113,7 @@ class Client:
         the primary; broadcasting is the transport-equivalent simplification
         until client pings land)."""
         assert self.in_flight is not None
+        self.busy = False
         for r in range(self.replica_count):
             self.network.send(self.client_id, r, self.in_flight)
 

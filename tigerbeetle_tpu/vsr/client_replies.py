@@ -26,7 +26,10 @@ class ClientReplies:
     def __init__(self, storage: Storage, cluster: ConfigCluster):
         self.storage = storage
         self.slot_size = cluster.message_size_max
-        self.slot_count = cluster.clients_max
+        # reply_slot_count, not clients_max: the ingress gateway's
+        # many-session mode raises clients_max far past what a
+        # slot-per-session zone could hold (constants.ConfigCluster)
+        self.slot_count = cluster.reply_slot_count
 
     def write(self, slot: int, wire: bytes) -> None:
         """Best-effort persistence (write_lazy): a reply lost to a crash

@@ -48,6 +48,12 @@ from tigerbeetle_tpu.vsr.journal import Journal
 from tigerbeetle_tpu.vsr.superblock import BlobRef, SuperBlock, VSRState
 
 SNAPSHOT_LEAVES = ("acct_rows", "xfer_rows", "fulfill")
+# Checkpoint blobs that are replica HOST state, not ledger state: they
+# ride the same grid area / sync-shipping machinery but the ledger
+# restore skips them (the replica reads its own back by name). Today:
+# the many-session client table (ingress mode), which at 10k+ sessions
+# overflows the 64 KiB superblock copy it used to inline into.
+HOST_BLOBS = frozenset({"client_table"})
 COUNTER_LEAVES = (
     "commit_ts", "acct_count", "xfer_count",
     "acct_used_slots", "xfer_used_slots",
@@ -85,6 +91,7 @@ def snapshot_to_superblock(
     commit_min: int,
     commit_min_checksum: int,
     extra_meta: dict | None = None,
+    extra_blobs: list[tuple[str, bytes]] | None = None,
 ) -> None:
     """Checkpoint the ledger state: blobs to the grid zone (ping-ponged by
     sequence parity), THEN the superblock records them — state first, mark
@@ -140,7 +147,18 @@ def snapshot_to_superblock(
         assert off + len(data) <= base + area_size, "grid area overflow"
         storage.write(Zone.grid, off, data)
         blobs.append(BlobRef("oracle", off, len(data), native.checksum(data)))
+        off += (len(data) + 4095) // 4096 * 4096
         meta = {"fault": 0, **carry, **(extra_meta or {})}
+    # host-state blobs (e.g. a many-session client table too large for
+    # the 64 KiB superblock copy): same area, same checksum discipline;
+    # restore_from_snapshot skips them (HOST_BLOBS) — the replica reads
+    # its own back via the superblock's refs
+    for name, data in extra_blobs or ():
+        assert name in HOST_BLOBS, name
+        assert off + len(data) <= base + area_size, "grid area overflow"
+        storage.write(Zone.grid, off, data)
+        blobs.append(BlobRef(name, off, len(data), native.checksum(data)))
+        off += (len(data) + 4095) // 4096 * 4096
     storage.sync()  # blobs durable before the superblock points at them
 
     superblock.checkpoint(VSRState(
@@ -183,6 +201,8 @@ def restore_from_snapshot(
     snapshot_to_superblock; fresh state when the superblock has no blobs)."""
     if hasattr(ledger, "restore_bytes"):  # oracle/native/sharded backend
         for ref in state.blobs:
+            if ref.name in HOST_BLOBS:
+                continue  # replica host state, not ledger state
             if ref.name != "oracle":
                 raise RuntimeError(
                     f"checkpoint blob {ref.name!r} was written by the DEVICE "
@@ -201,6 +221,8 @@ def restore_from_snapshot(
     dev = init_state(process)
     if state.blobs:
         for ref in state.blobs:
+            if ref.name in HOST_BLOBS:
+                continue  # replica host state, not ledger state
             if ref.name == "oracle":
                 raise RuntimeError(
                     "checkpoint blob was written by the native/oracle "

@@ -65,6 +65,12 @@ class Command(enum.IntEnum):
     sync_manifest = 24
     sync_free_set = 25
     sync_client_sessions = 26
+    # Ingress extension (tigerbeetle_tpu/ingress): a typed load-shed
+    # reply. The gateway answers a request it cannot admit (saturated
+    # commit pipeline / exhausted message pool / session table full) with
+    # `busy` echoing the client + request number — the client backs off
+    # and retries, instead of timing out against a silent drop.
+    busy = 27
 
 
 # Vectorized view of the same layout (batch scans over header rings);

@@ -35,6 +35,7 @@ simulator run this exact code.
 from __future__ import annotations
 
 import dataclasses
+import json as _json
 from collections import deque
 from time import perf_counter_ns
 
@@ -69,6 +70,10 @@ RETRY_TICKS = 16  # view-change message retry cadence
 GRID_SCRUB_TICKS = 8  # forest-block scrub cadence (reference: grid scrubber)
 GRID_SCRUB_BLOCKS = 8  # acquired blocks verified per scrub pass
 WAL_SWEEP_TICKS = 64  # in-place-fault WAL re-verify cadence (1 MiB/pass)
+# Client tables whose JSON exceeds this inline into the superblock meta;
+# larger ones (many-session ingress mode) spill to a checkpoint blob —
+# the 64 KiB superblock copy must also hold the rest of the meta.
+CLIENT_TABLE_INLINE_MAX = 24 * 1024
 
 # CDC reply-ring retention: only create-op replies (sparse failure
 # structs) are kept for resume-from-WAL; read replies are large and the
@@ -256,6 +261,13 @@ class Replica:
         # so a pump resuming from the WAL ring can rebuild exact records
         # for ops it missed while down.
         self.cdc_hook = None
+        # Ingress gateway seam: called with the victim client id when a
+        # register at clients_max evicts the oldest session, so the
+        # gateway's session table tracks the replica's — without it,
+        # evicted sessions on a still-open multiplexed connection would
+        # pin the gateway's sessions_max cap forever (conn close never
+        # fires while other sessions keep the connection alive).
+        self.ingress_evict_hook = None
         self.cdc_retain = False
         self.cdc_replies: dict[int, bytes] = {}
         # Finalized-op watermark: with an async commit window, commit_min
@@ -265,6 +277,14 @@ class Replica:
         # stream-safe bound: the highest op whose finalize has run (or
         # that a restore/state-sync declared executed elsewhere).
         self.cdc_commit_min = 0
+
+        # Durable reply-slot free list (client_replies zone): maintained
+        # incrementally so a register is O(1) — with the ingress gateway
+        # multiplexing tens of thousands of sessions, the old per-register
+        # scan over the whole client table was O(sessions^2) across a
+        # connect storm. None = rebuild lazily from the table (set at
+        # every point the table is wholesale replaced).
+        self._reply_slots_free: list[int] | None = None
 
         # tick + view-change state
         self.ticks = 0
@@ -300,6 +320,39 @@ class Replica:
     def quorum_view_change(self) -> int:
         return self.replica_count // 2 + 1
 
+    # -- ingress saturation signal + reply-slot allocator --------------
+
+    def ingress_occupancy(self) -> tuple[int, int]:
+        """(used, capacity) of the commit pipeline — the admission signal
+        the ingress gateway's credit regulator reads every request (so it
+        must stay O(1)). `used` counts quorum-pending pipeline entries
+        plus dispatched-but-unfinalized commits beyond the steady async
+        window; `capacity` is the same cap _on_request backpressures at,
+        so the gateway sheds with a typed busy reply just before the
+        replica would start dropping silently."""
+        cap = max(
+            self.cluster.pipeline_prepare_queue_max, 2 * self.commit_window
+        )
+        backlog = max(0, len(self._inflight) - max(1, self.commit_window))
+        return len(self.pipeline) + backlog, cap
+
+    def _reply_slot_alloc(self) -> int | None:
+        """Pop a free client_replies slot (None when every slot is owned
+        — the session registers without durable reply persistence)."""
+        if self._reply_slots_free is None:
+            used = {
+                e.get("slot") for e in self.client_table.values()
+            } - {None}
+            self._reply_slots_free = [
+                i for i in range(self.client_replies.slot_count - 1, -1, -1)
+                if i not in used
+            ]
+        return self._reply_slots_free.pop() if self._reply_slots_free else None
+
+    def _reply_slot_release(self, slot: int | None) -> None:
+        if slot is not None and self._reply_slots_free is not None:
+            self._reply_slots_free.append(slot)
+
     def open(self) -> None:
         """Superblock -> snapshot -> WAL replay (same recovery as the
         single-replica DurableLedger, then join the cluster)."""
@@ -310,8 +363,9 @@ class Replica:
         )
         self.client_table = {
             int(c): dict(e, reply=None)
-            for c, e in state.meta.get("client_table", {}).items()
+            for c, e in self._load_client_table(state).items()
         }
+        self._reply_slots_free = None  # rebuilt from the restored table
         self._restore_client_replies()
         persisted_view = int(state.meta.get("view", 0))
         persisted_log_view = int(state.meta.get("log_view", persisted_view))
@@ -406,17 +460,48 @@ class Replica:
             }
             for c, e in self.client_table.items()
         }
+        extra_meta = {"view": self.view, "log_view": self.log_view}
+        extra_blobs = None
+        encoded = _json.dumps(table, sort_keys=True).encode()
+        if len(encoded) > CLIENT_TABLE_INLINE_MAX:
+            # many-session ingress mode: the table no longer fits the
+            # 64 KiB superblock copy — spill it to a checkpoint blob in
+            # the grid area (rides the same sync-shipping machinery;
+            # _load_client_table reads it back by name)
+            extra_meta["client_table_blob"] = True
+            extra_blobs = [("client_table", encoded)]
+        else:
+            extra_meta["client_table"] = table
         snapshot_to_superblock(
             self.storage, self.ledger, self.sm, self.superblock,
             commit_min=self.commit_min,
             commit_min_checksum=self.commit_checksum,
-            extra_meta={
-                "client_table": table,
-                "view": self.view,
-                "log_view": self.log_view,
-            },
+            extra_meta=extra_meta,
+            extra_blobs=extra_blobs,
         )
         self.checkpoint_op = self.commit_min
+
+    def _load_client_table(self, state) -> dict:
+        """The checkpointed client table: inline in the superblock meta,
+        or — when a many-session table overflowed the copy — from its
+        grid blob (written by _checkpoint, shipped by state sync)."""
+        if not state.meta.get("client_table_blob"):
+            return state.meta.get("client_table", {})
+        from tigerbeetle_tpu import native
+        from tigerbeetle_tpu.io.storage import Zone
+
+        for ref in state.blobs:
+            if ref.name == "client_table":
+                raw = self.storage.read(Zone.grid, ref.offset, ref.size)
+                if native.checksum(raw) != ref.checksum:
+                    raise RuntimeError(
+                        "client_table checkpoint blob: bad checksum"
+                    )
+                return _json.loads(raw.decode())
+        raise RuntimeError(
+            "checkpoint flags a client_table blob but the superblock "
+            "references none"
+        )
 
     def _maybe_checkpoint(self, next_op: int) -> None:
         """WAL-wrap guard: never let a prepare overwrite an op that is not
@@ -1324,8 +1409,9 @@ class Replica:
         )
         self.client_table = {
             int(c): dict(e, reply=None)
-            for c, e in meta.get("client_table", {}).items()
+            for c, e in self._load_client_table(new_state).items()
         }
+        self._reply_slots_free = None  # rebuilt from the adopted table
         self._restore_client_replies()
         self.checkpoint_op = new_state.commit_min
         self.commit_min = self.commit_max = self.op = new_state.commit_min
@@ -1623,30 +1709,38 @@ class Replica:
             # one) and tell that client (reference:
             # src/vsr/replica.zig:3758-3860 + eviction command,
             # src/vsr.zig:136). Its slot is then free for the newcomer.
-            if (
-                header.client not in self.client_table
-                and len(self.client_table) >= self.cluster.clients_max
-            ):
+            prior = self.client_table.pop(header.client, None)
+            if prior is not None:
+                # Duplicate register EXECUTING (a view change can carry
+                # the same client's register twice in the surviving log):
+                # the re-insert below replaces the entry, so release its
+                # slot or it leaks from the free list until the next
+                # restart's rebuild (the old O(sessions) scan self-healed
+                # here; the incremental list must be told). Popped BEFORE
+                # the release/alloc: with the list still unbuilt (first
+                # register after a restart), release is a no-op and the
+                # lazy rebuild must not count the replaced entry's slot
+                # as owned.
+                self._reply_slot_release(prior.get("slot"))
+            elif len(self.client_table) >= self.cluster.clients_max:
                 victim = min(
                     self.client_table,
                     key=lambda c: self.client_table[c]["session"],
                 )
-                del self.client_table[victim]
+                evicted = self.client_table.pop(victim)
+                self._reply_slot_release(evicted.get("slot"))
                 if self.is_primary:
                     self._send_eviction(victim)
-            used = {
-                e.get("slot") for e in self.client_table.values()
-            } - {None}
-            free = [
-                i for i in range(self.client_replies.slot_count)
-                if i not in used
-            ]
+                if self.ingress_evict_hook is not None:
+                    self.ingress_evict_hook(victim)
             self.client_table[header.client] = {
                 "session": header.op,
                 "request": 0,
                 "reply": None,
-                # reply-persistence slot (reference: client_replies.zig)
-                "slot": free[0] if free else None,
+                # reply-persistence slot (reference: client_replies.zig);
+                # None once every slot is owned (many-session ingress
+                # mode: reply_slot_count < clients_max)
+                "slot": self._reply_slot_alloc(),
             }
             reply_body = header.op.to_bytes(8, "little")  # session number
         else:
