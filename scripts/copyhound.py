@@ -1,84 +1,36 @@
 #!/usr/bin/env python
-"""Copyhound: find host<->device copy inducers in the device compute path.
+"""Copyhound — now a vet pass (`python scripts/vet.py --pass copyhound`).
 
-The reference's copyhound scans LLVM IR for accidental large memcpys
-(reference: src/copyhound.zig:1-9). The TPU analog of an accidental
-memcpy is an accidental DEVICE SYNC or host round-trip in the compute
-path: `np.asarray(...)` on a device array, `.block_until_ready()`,
-`jax.device_get`, `float()/int()` coercions of device scalars, and
-`.tobytes()` pulls. Each one stalls dispatch (see ops/hashtable.py on why
-dispatch health is the flagship constraint).
-
-This scans ops/, models/, parallel/ for those call sites and compares the
-set against `scripts/copyhound_baseline.json`. NEW sites fail the check:
-either justify the sync (it is on a cold path) and re-baseline with
---update, or remove it.
+This shim keeps the historical entry point (and its --update flow)
+alive. v2 scans the whole commit path (ops/ models/ parallel/ vsr/ lsm/
+cdc/ ingress/ io/), adds the implicit sync inducers (.item(), device
+coercions, numpy-on-jax, device arrays in f-strings), and the baseline
+is CLOSED: stale entries fail, and every entry carries a human `why`.
+The implementation lives in tigerbeetle_tpu/devtools/copyhound_pass.py.
 """
 
 from __future__ import annotations
 
-import ast
-import json
 import pathlib
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-BASELINE = ROOT / "scripts" / "copyhound_baseline.json"
-SCAN_DIRS = ("tigerbeetle_tpu/ops", "tigerbeetle_tpu/models",
-             "tigerbeetle_tpu/parallel")
+sys.path.insert(0, str(ROOT))
 
-SYNC_CALLS = {"asarray", "block_until_ready", "device_get", "tobytes",
-              "from_dlpack"}
-
-
-def scan() -> dict[str, list[str]]:
-    sites: dict[str, list[str]] = {}
-    for d in SCAN_DIRS:
-        for path in sorted((ROOT / d).rglob("*.py")):
-            rel = str(path.relative_to(ROOT))
-            tree = ast.parse(path.read_text())
-            found = []
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                f = node.func
-                name = None
-                if isinstance(f, ast.Attribute) and f.attr in SYNC_CALLS:
-                    name = f.attr
-                elif isinstance(f, ast.Name) and f.id in SYNC_CALLS:
-                    name = f.id
-                if name:
-                    # function context for a stable-ish key
-                    found.append(f"{name}@{node.lineno}")
-            if found:
-                sites[rel] = found
-    return sites
+from tigerbeetle_tpu import devtools  # noqa: E402
 
 
 def main() -> int:
     update = "--update" in sys.argv
-    sites = scan()
-    counts = {
-        rel: sorted({s.split("@")[0] for s in v}) and
-        {kind: sum(1 for s in v if s.startswith(kind + "@"))
-         for kind in sorted({s.split("@")[0] for s in v})}
-        for rel, v in sites.items()
-    }
-    if update or not BASELINE.exists():
-        BASELINE.write_text(json.dumps(counts, indent=1, sort_keys=True) + "\n")
-        print(f"baseline written: {BASELINE.name}")
-        return 0
-    base = json.loads(BASELINE.read_text())
-    grew = []
-    for rel, kinds in counts.items():
-        for kind, n in kinds.items():
-            if n > base.get(rel, {}).get(kind, 0):
-                grew.append(f"{rel}: {kind} sites {base.get(rel, {}).get(kind, 0)} -> {n}")
-    if grew:
-        print("copyhound: NEW host-device sync sites in the compute path "
-              "(justify + rerun with --update, or remove):")
-        for g in grew:
-            print(" ", g)
+    violations, notes = devtools.run_vet(
+        ROOT, pass_names=["copyhound"], update=update
+    )
+    for note in notes:
+        print(note)
+    for v in violations:
+        print(v.render())
+    if violations:
+        print(f"copyhound: {len(violations)} problem(s)")
         return 1
     print("copyhound: clean")
     return 0

@@ -1,109 +1,29 @@
 #!/usr/bin/env python
-"""Source lint (the reference's tidy.zig / TigerStyle lint analog,
-reference: src/tidy.zig:1-9, scripts/lint_tigerstyle.zig).
+"""Source lint — now a vet pass (`python scripts/vet.py --pass tidy`).
 
-Checks every Python source in the repo:
-- no tabs, no trailing whitespace, lines <= 100 columns;
-- no unused imports (AST-verified; `# noqa` opts a line out);
-- `print()` only in user-facing surfaces (CLI/REPL/scripts/bench) —
-  library code logs or returns, it does not print.
-
-Exit code 1 on any violation; run from the repo root.
+This shim keeps the historical entry point alive: same checks (no tabs,
+no trailing whitespace, <=100 columns, unused imports, library prints)
+plus the v2 rule that `# noqa` must name the check it suppresses. The
+implementation lives in tigerbeetle_tpu/devtools/tidy_pass.py.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
 
-LINE_MAX = 100
-# Golden-vector fixture tables transcribed verbatim from the reference's
-# test tables keep the reference's own formatting.
-LINE_MAX_EXEMPT = {"tests/test_golden.py"}
-PRINT_OK = {
-    "tigerbeetle_tpu/cli.py", "tigerbeetle_tpu/repl.py",
-    "tigerbeetle_tpu/__main__.py", "bench.py", "__graft_entry__.py",
-}
-
-
-def py_files():
-    for base in ("tigerbeetle_tpu", "tests", "scripts"):
-        yield from sorted((ROOT / base).rglob("*.py"))
-    yield ROOT / "bench.py"
-    yield ROOT / "__graft_entry__.py"
-
-
-def used_names(tree: ast.AST) -> set[str]:
-    out = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            out.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            n = node
-            while isinstance(n, ast.Attribute):
-                n = n.value
-            if isinstance(n, ast.Name):
-                out.add(n.id)
-    return out
-
-
-def check_file(path: pathlib.Path) -> list[str]:
-    rel = str(path.relative_to(ROOT))
-    text = path.read_text()
-    problems = []
-    for i, line in enumerate(text.splitlines(), 1):
-        if "\t" in line:
-            problems.append(f"{rel}:{i}: tab character")
-        if line != line.rstrip():
-            problems.append(f"{rel}:{i}: trailing whitespace")
-        if len(line) > LINE_MAX and rel not in LINE_MAX_EXEMPT:
-            problems.append(f"{rel}:{i}: line exceeds {LINE_MAX} columns")
-    try:
-        tree = ast.parse(text)
-    except SyntaxError as e:
-        return [f"{rel}: syntax error: {e}"]
-    noqa = {
-        i for i, line in enumerate(text.splitlines(), 1) if "# noqa" in line
-    }
-    used = used_names(tree)
-    in_init = path.name == "__init__.py"
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.Import, ast.ImportFrom)) and not in_init:
-            if node.lineno in noqa:
-                continue
-            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
-                continue
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                name = (alias.asname or alias.name).split(".")[0]
-                if name not in used:
-                    problems.append(
-                        f"{rel}:{node.lineno}: unused import {name!r}"
-                    )
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-            and rel.startswith("tigerbeetle_tpu/")
-            and rel not in PRINT_OK
-            and node.lineno not in noqa
-        ):
-            problems.append(f"{rel}:{node.lineno}: print() in library code")
-    return problems
+from tigerbeetle_tpu import devtools  # noqa: E402
 
 
 def main() -> int:
-    problems = []
-    for path in py_files():
-        problems += check_file(path)
-    for p in problems:
-        print(p)
-    if problems:
-        print(f"tidy: {len(problems)} problem(s)")
+    violations, _ = devtools.run_vet(ROOT, pass_names=["tidy"])
+    for v in violations:
+        print(v.render())
+    if violations:
+        print(f"tidy: {len(violations)} problem(s)")
         return 1
     return 0
 

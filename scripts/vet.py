@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""vet: the repo's static-analysis driver (reference: src/tidy.zig +
+src/copyhound.zig run as build steps, not review comments).
+
+Usage:
+  python scripts/vet.py                 # all passes, exit 1 on any hit
+  python scripts/vet.py --pass tidy,races
+  python scripts/vet.py --update        # rewrite baselines (whys kept;
+                                        # NEW sites need a human why
+                                        # before the run goes green)
+  python scripts/vet.py --update --pass copyhound
+  python scripts/vet.py --explain races
+  python scripts/vet.py --explain copyhound/coerce
+  python scripts/vet.py --json          # machine-readable violations
+
+Passes: tidy (source form + named noqa), copyhound (host<->device sync
+inducers), races (thread-ownership lint), determinism (sim-reachable
+code stays seed-deterministic). Baselines are CLOSED: new sites fail,
+vanished baselined sites fail, and every entry needs a `why`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from tigerbeetle_tpu import devtools  # noqa: E402
+
+
+def explain(topic: str) -> int:
+    passes = devtools.make_passes()
+    if "/" in topic:
+        pass_name, check = topic.split("/", 1)
+    else:
+        pass_name, check = topic, None
+    for p in passes:
+        if p.name != pass_name:
+            continue
+        if check is None:
+            print((p.doc or "").strip())
+            print("\nchecks:")
+            for cid, text in sorted(p.checks.items()):
+                print(f"  {p.name}/{cid}: {text}")
+            return 0
+        if check in p.checks:
+            print(f"{p.name}/{check}: {p.checks[check]}")
+            return 0
+        print(f"no check {check!r} in pass {pass_name!r} "
+              f"(have {sorted(p.checks)})")
+        return 1
+    print(f"no pass {topic!r} (have {[p.name for p in passes]})")
+    return 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pass", dest="passes", default=None,
+                    help="comma-separated subset of passes to run")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the selected passes' baselines")
+    ap.add_argument("--explain", metavar="PASS[/CHECK]",
+                    help="print a pass's (or one check's) documentation")
+    ap.add_argument("--json", action="store_true",
+                    help="emit violations as JSON")
+    args = ap.parse_args()
+    if args.explain:
+        return explain(args.explain)
+    names = args.passes.split(",") if args.passes else None
+    violations, notes = devtools.run_vet(
+        ROOT, pass_names=names, update=args.update
+    )
+    # --json keeps stdout pure JSON (json.loads(stdout) must work);
+    # human-facing notes and the summary go to stderr there
+    human = sys.stdout if not args.json else sys.stderr
+    for note in notes:
+        print(f"vet: {note}", file=human)
+    if args.json:
+        print(json.dumps(
+            [v.__dict__ for v in violations], indent=1, sort_keys=True
+        ))
+    else:
+        for v in violations:
+            print(v.render())
+    if violations:
+        by_pass: dict[str, int] = {}
+        for v in violations:
+            by_pass[v.pass_name] = by_pass.get(v.pass_name, 0) + 1
+        summary = ", ".join(f"{k}={n}" for k, n in sorted(by_pass.items()))
+        print(f"vet: {len(violations)} problem(s) ({summary})", file=human)
+        return 1
+    ran = names or [p.name for p in devtools.make_passes()]
+    print(f"vet: clean ({', '.join(ran)})", file=human)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,24 +1,855 @@
-"""Dev tools stay green (reference: tidy.zig + copyhound.zig analogs):
-the tree must pass its own lint, and the compute path must not grow new
-host-device sync sites without a deliberate re-baseline."""
+"""The vet static-analysis suite (reference: tidy.zig + copyhound.zig
+run as build steps).
 
+Two layers:
+
+- fixture tests drive each pass over in-memory toy sources: an
+  annotated-correct fixture must pass, and a seeded mutation of the same
+  fixture must fail with the expected check id — so the passes are
+  tested the way the code they guard is (positive AND negative);
+- end-to-end tier-1 tests run `scripts/vet.py` (and the historical
+  tidy/copyhound shims) against the real tree and assert green, so a
+  regression in any pass — or a new unannotated shared field, sync
+  inducer, or nondeterminism source — fails `pytest -q`.
+"""
+
+import json
 import pathlib
 import subprocess
 import sys
 
+import pytest
+
+from tigerbeetle_tpu.devtools import (
+    CopyhoundPass,
+    DeterminismPass,
+    RacePass,
+    TidyPass,
+    VetConfig,
+)
+from tigerbeetle_tpu.devtools.base import (
+    SourceFile,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 
-def _run(script):
-    return subprocess.run([sys.executable, f"scripts/{script}"], cwd=ROOT,
-                          capture_output=True, text=True)
+def cfg(**kw) -> VetConfig:
+    return VetConfig(root=ROOT, **kw)
 
 
-def test_tidy_clean():
+def run_on(pass_, config, **files):
+    srcs = [SourceFile(rel, text) for rel, text in sorted(files.items())]
+    return pass_.run(srcs, config)
+
+
+def checks_of(violations):
+    return sorted({v.check for v in violations})
+
+
+# ----------------------------------------------------------------------
+# races: thread-ownership lint
+# ----------------------------------------------------------------------
+
+RACE_OK = '''\
+import threading
+
+class Pipe:
+    def __init__(self):
+        self.q = Queue()  # vet: handoff
+        self._lock = threading.Lock()
+        self._count = 0  # vet: guarded-by=_lock
+        self._scratch = []  # vet: owner=writer
+        self._thread = threading.Thread(target=self._loop, name="writer")
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            item = self.q.get()
+            self._scratch.append(item)
+            with self._lock:
+                self._count += 1
+
+    def push(self, item):
+        self.q.put(item)
+
+    def count(self):
+        with self._lock:
+            return self._count
+'''
+
+
+def test_races_annotated_fixture_is_clean():
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": RACE_OK})
+    assert out == [], [v.render() for v in out]
+
+
+def test_races_unannotated_cross_thread_write_fails():
+    src = RACE_OK.replace("self._scratch = []  # vet: owner=writer",
+                          "self._scratch = []")
+    # push() now also touches the writer thread's list
+    src = src.replace("self.q.put(item)",
+                      "self.q.put(item)\n        self._scratch.append(item)")
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert checks_of(out) == ["unannotated-shared"]
+    assert any("_scratch" in v.message for v in out)
+    # the violation is baselinable with a stable per-attribute site key
+    assert out[0].site == "fix.py::Pipe._scratch"
+
+
+def test_races_owner_violated_from_event_loop():
+    src = RACE_OK.replace(
+        "self.q.put(item)",
+        "self.q.put(item)\n        self._scratch.append(item)")
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert checks_of(out) == ["owner"]
+    assert any("main" in v.message and "owner=writer" in v.message
+               for v in out)
+
+
+def test_races_guarded_by_write_outside_lock_fails():
+    src = RACE_OK.replace(
+        "            with self._lock:\n                self._count += 1",
+        "            self._count += 1")
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert checks_of(out) == ["guarded-by"]
+    assert any("without holding self._lock" in v.message for v in out)
+
+
+def test_races_guarded_by_unknown_lock_is_bad_annotation():
+    src = RACE_OK.replace("guarded-by=_lock", "guarded-by=_no_such_lock")
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert "bad-annotation" in checks_of(out)
+
+
+def test_races_malformed_annotation_is_reported():
+    src = RACE_OK.replace("# vet: handoff", "# vet: trust-me")
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert "bad-annotation" in checks_of(out)
+
+
+def test_races_executor_submit_and_callback_infer_threads():
+    src = '''\
+class Spiller:
+    def __init__(self, io):
+        self._io = io
+        self.jobs = 0
+
+    def kick(self):
+        def job():
+            self.jobs += 1
+        fut = self._io.submit(job)
+        fut.add_done_callback(self._done)
+
+    def _done(self, fut):
+        self.jobs += 1
+
+    def report(self):
+        return self.jobs
+'''
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert checks_of(out) == ["unannotated-shared"]
+    msg = out[0].message
+    # the seeded bug crosses the worker (submit), the completing thread
+    # (add_done_callback), and the event loop (report)
+    assert "worker:_io" in msg and "callback" in msg and "main" in msg
+
+
+def test_races_lambda_callback_runs_on_the_spawn_thread():
+    # review fix: a mutator at the top level of a lambda spawn arg was
+    # invisible (generic_visit skipped the body's own node), and must be
+    # attributed to the CALLBACK thread, not the enclosing method's
+    src = '''\
+class Tracker:
+    def __init__(self):
+        self._pending = set()
+
+    def kick(self, fut):
+        self._pending.add(fut)
+        fut.add_done_callback(lambda f: self._pending.discard(f))
+'''
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert checks_of(out) == ["unannotated-shared"]
+    assert "callback" in out[0].message and "main" in out[0].message
+    # worker-side-only mutation via a submitted lambda is NOT flagged as
+    # shared with the enclosing thread (the body never runs there)
+    src2 = '''\
+class Logger:
+    def __init__(self, io):
+        self._io = io
+
+    def kick(self):
+        self._io.submit(lambda: self._lines.append(1))
+'''
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src2})
+    assert out == []
+
+
+def test_races_bare_name_thread_spawn_is_seen():
+    # review fix: `from threading import Thread` spawns with a bare
+    # Name call, which used to bypass spawn recognition entirely — the
+    # unannotated cross-thread write below came back with ZERO
+    # violations because every method collapsed onto "main"
+    src = RACE_OK.replace("import threading\n",
+                          "from threading import Thread, Lock\n")
+    src = src.replace("threading.", "")
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert out == [], [v.render() for v in out]
+    bad = src.replace("self._scratch = []  # vet: owner=writer",
+                      "self._scratch = []")
+    bad = bad.replace("self.q.put(item)",
+                      "self.q.put(item)\n        self._scratch.append(item)")
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": bad})
+    assert checks_of(out) == ["unannotated-shared"]
+    assert any("_scratch" in v.message for v in out)
+    # an ALIASED from-import must not evade either
+    aliased = bad.replace("from threading import Thread, Lock",
+                          "from threading import Lock\n"
+                          "from threading import Thread as _T")
+    aliased = aliased.replace("Thread(target=", "_T(target=")
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": aliased})
+    assert checks_of(out) == ["unannotated-shared"], \
+        [v.render() for v in out]
+
+
+def test_races_thread_spawned_from_init_is_not_construction():
+    # review fix: the __init__ construction exemption also swallowed
+    # nested functions SPAWNED from __init__ — `def loop(): ...;
+    # Thread(target=loop)` in a constructor runs on the spawned thread
+    # later, and its cross-thread accesses were dropped entirely
+    src = '''\
+from threading import Thread
+
+class Pump:
+    def __init__(self):
+        self._buf = []
+
+        def loop():
+            self._buf.append(1)
+
+        Thread(target=loop, name="pump").start()
+
+    def drain(self):
+        return list(self._buf)
+'''
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert checks_of(out) == ["unannotated-shared"]
+    assert any("_buf" in v.message for v in out), \
+        [v.render() for v in out]
+
+
+def test_races_submit_data_args_are_not_spawn_targets():
+    # review fix: every positional submit() arg used to be treated as
+    # a potential spawn target, so a DATA argument whose name collides
+    # with a method moved that method onto the worker thread and fired
+    # a spurious unannotated-shared
+    src = '''\
+class Box:
+    def __init__(self):
+        self._ex = Pool()
+        self._n = 0
+
+    def _job(self, arg):
+        pass
+
+    def kick(self):
+        flush = 1
+        self._ex.submit(self._job, flush)
+
+    def flush(self):
+        self._n += 1
+
+    def read(self):
+        return self._n
+'''
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert out == [], [v.render() for v in out]
+
+
+def test_races_augassign_rhs_attribute_read_is_seen():
+    # review fix: visit_AugAssign generic_visit'ed the RHS, dropping a
+    # top-level self-attribute read — `self.total += self.base` on the
+    # worker never recorded the base read, silencing a real race
+    src = RACE_OK.replace(
+        "            self._scratch.append(item)",
+        "            self._scratch.append(item)\n"
+        "            self._total += self._base",
+    )
+    src = src.replace(
+        "    def push(self, item):",
+        "    def rebase(self, b):\n"
+        "        self._base = b\n\n"
+        "    def push(self, item):",
+    )
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert "unannotated-shared" in checks_of(out)
+    assert any("_base" in v.message for v in out), \
+        [v.render() for v in out]
+
+
+def test_races_positional_thread_target_is_seen():
+    # review fix: spawn recognition only read the `target=` keyword —
+    # threading.Thread(None, self._loop) (the positional signature) got
+    # zero race coverage silently
+    src = '''\
+import threading
+
+class Tail:
+    def __init__(self):
+        self._items = []
+        threading.Thread(None, self._loop).start()
+
+    def _loop(self):
+        self._items.append(1)
+
+    def drain(self):
+        return list(self._items)
+'''
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert checks_of(out) == ["unannotated-shared"]
+    assert any("_items" in v.message for v in out), \
+        [v.render() for v in out]
+
+
+def test_races_augassign_index_read_is_seen():
+    # review fix: `self.buf[self.head] += 1` recorded the buf write but
+    # never the head READ, so a cross-thread unannotated index attr was
+    # invisible when only touched inside augmented-subscript indices
+    src = RACE_OK.replace(
+        "            self._scratch.append(item)",
+        "            self._scratch.append(item)\n"
+        "            self._slots[self._head] += 1",
+    )
+    src = src.replace(
+        "    def push(self, item):",
+        "    def reset(self):\n"
+        "        self._head = 0\n\n"
+        "    def push(self, item):",
+    )
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"fix.py"})),
+                 **{"fix.py": src})
+    assert "unannotated-shared" in checks_of(out)
+    assert any("_head" in v.message for v in out), \
+        [v.render() for v in out]
+
+
+def test_races_files_outside_scan_set_are_ignored():
+    src = RACE_OK.replace("self._scratch = []  # vet: owner=writer",
+                          "self._scratch = []")
+    out = run_on(RacePass(), cfg(race_scan=frozenset({"other.py"})),
+                 **{"fix.py": src})
+    assert out == []
+
+
+# ----------------------------------------------------------------------
+# determinism: sim-reachable code stays seed-deterministic
+# ----------------------------------------------------------------------
+
+def det_cfg(**kw):
+    kw.setdefault("sim_roots", ("simroot.py",))
+    kw.setdefault("prod_only", {})
+    kw.setdefault("clock_seam", frozenset())
+    kw.setdefault("executor_seam", {})
+    return cfg(**kw)
+
+
+SIM_ROOT = "import simmod\n"
+
+
+def test_determinism_clean_module_passes():
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": SIM_ROOT,
+        "simmod.py": "def step(rng):\n    return rng.random()\n",
+    })
+    assert out == []
+
+
+@pytest.mark.parametrize("body,check", [
+    ("import time\n\ndef now():\n    return time.time()\n", "wall-clock"),
+    ("import time as _t\n\ndef now():\n    return _t.perf_counter()\n",
+     "wall-clock"),
+    ("import random\n\ndef roll():\n    return random.random()\n",
+     "unseeded-random"),
+    ("import random\n\ndef rng():\n    return random.Random()\n",
+     "unseeded-random"),
+    ("import os\n\ndef salt():\n    return os.urandom(8)\n",
+     "unseeded-random"),
+    ("def drain(ids):\n    seen = set(ids)\n"
+     "    return [i for i in seen]\n", "set-iteration"),
+    ("import threading\n\ndef spawn(fn):\n"
+     "    threading.Thread(target=fn).start()\n", "direct-thread"),
+])
+def test_determinism_rejects_nondeterminism_sources(body, check):
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": SIM_ROOT, "simmod.py": body,
+    })
+    assert checks_of(out) == [check], [v.render() for v in out]
+
+
+@pytest.mark.parametrize("body,check", [
+    ("from time import perf_counter\n\ndef now():\n"
+     "    return perf_counter()\n", "wall-clock"),
+    ("from time import perf_counter_ns as pc\n\ndef now():\n"
+     "    return pc()\n", "wall-clock"),
+    ("from random import random\n\ndef roll():\n    return random()\n",
+     "unseeded-random"),
+    ("from random import Random\n\ndef rng():\n    return Random()\n",
+     "unseeded-random"),
+    ("from os import urandom\n\ndef salt():\n    return urandom(8)\n",
+     "unseeded-random"),
+    ("from uuid import uuid4 as mkid\n\ndef new_id():\n"
+     "    return mkid()\n", "unseeded-random"),
+])
+def test_determinism_rejects_from_imported_sources(body, check):
+    # review fix: from-imports bind bare names, which the dotted
+    # two-part checks never matched — one import-style change used to
+    # silently defeat the lint
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": SIM_ROOT, "simmod.py": body,
+    })
+    assert checks_of(out) == [check], [v.render() for v in out]
+
+
+def test_determinism_from_imported_seeded_random_is_fine():
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": SIM_ROOT,
+        "simmod.py": "from random import Random\n\ndef rng(seed):\n"
+                     "    return Random(seed)\n",
+    })
+    assert out == []
+
+
+def test_determinism_seeded_random_is_fine():
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": SIM_ROOT,
+        "simmod.py": "import random\n\ndef rng(seed):\n"
+                     "    return random.Random(seed)\n",
+    })
+    assert out == []
+
+
+def test_determinism_set_locals_are_function_scoped():
+    # review fix: the set-typed-name map was file-global, so a set
+    # local in one function flagged iteration over an unrelated
+    # like-named list local in another function
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": SIM_ROOT,
+        "simmod.py": "def a():\n"
+                     "    pending = set()\n"
+                     "    return sorted(pending)\n\n"
+                     "def b(items):\n"
+                     "    pending = list(items)\n"
+                     "    return [p for p in pending]\n",
+    })
+    assert out == [], [v.render() for v in out]
+
+
+def test_determinism_set_attributes_stay_file_wide():
+    # `self.x` keys are attributes, not locals — assigned a set in
+    # __init__, iterating them in another method must still flag
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": SIM_ROOT,
+        "simmod.py": "class T:\n"
+                     "    def __init__(self):\n"
+                     "        self.ids = set()\n\n"
+                     "    def drain(self):\n"
+                     "        return [i for i in self.ids]\n",
+    })
+    assert checks_of(out) == ["set-iteration"], \
+        [v.render() for v in out]
+
+
+def test_determinism_sorted_set_iteration_is_fine():
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": SIM_ROOT,
+        "simmod.py": "def drain(ids):\n    seen = set(ids)\n"
+                     "    return [i for i in sorted(seen)]\n",
+    })
+    assert out == []
+
+
+def test_determinism_roots_are_themselves_in_scope():
+    # review fix: the closure anchors on the roots, it does not exempt
+    # them — a wall clock in the VOPR driver itself must flag
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": "import time\n\ndef main():\n"
+                      "    return time.time()\n",
+    })
+    assert checks_of(out) == ["wall-clock"]
+
+
+def test_determinism_scope_is_the_import_closure():
+    # same wall-clock body, but the module is never imported by a root
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": "X = 1\n",
+        "simmod.py": "import time\n\ndef now():\n    return time.time()\n",
+    })
+    assert out == []
+
+
+def test_determinism_closure_follows_relative_imports():
+    # review fix: relative imports (level > 0) used to be dropped from
+    # the closure, silently unscanning the imported subtree — both from
+    # a regular module (`pkg/root.py`) and from a package __init__,
+    # whose first dot level is the package itself
+    files = {
+        "pkg/__init__.py": "from . import depmod\n",
+        "pkg/root.py": "from . import simmod\nfrom .other import thing\n",
+        "pkg/simmod.py": "import time\n\ndef a():\n    return time.time()\n",
+        "pkg/other.py": "import time\n\ndef b():\n"
+                        "    return time.monotonic()\n",
+        "pkg/depmod.py": "import time\n\ndef c():\n"
+                         "    return time.perf_counter()\n",
+    }
+    out = run_on(DeterminismPass(), det_cfg(sim_roots=("pkg/root.py",)),
+                 **files)
+    assert checks_of(out) == ["wall-clock"]
+    assert {v.file for v in out} == {
+        "pkg/simmod.py", "pkg/other.py", "pkg/depmod.py"
+    }, [v.render() for v in out]
+
+
+def test_determinism_closure_includes_ancestor_packages():
+    # review fix: `import pkg.sub.mod` executes pkg/__init__ and
+    # pkg/sub/__init__ at runtime; those used to be absent from the
+    # closure, so a wall clock in a package __init__ passed silently
+    files = {
+        "pkg/__init__.py": "import time\n\ndef boot():\n"
+                           "    return time.time()\n",
+        "pkg/sub/__init__.py": "",
+        "pkg/sub/mod.py": "X = 1\n",
+        "root.py": "import pkg.sub.mod\n",
+    }
+    out = run_on(DeterminismPass(), det_cfg(sim_roots=("root.py",)),
+                 **files)
+    assert checks_of(out) == ["wall-clock"]
+    assert {v.file for v in out} == {"pkg/__init__.py"}, \
+        [v.render() for v in out]
+
+
+def test_determinism_clock_seam_parameter_named_time_is_fine():
+    # review fix: the module-alias sets were unconditionally seeded
+    # with "time"/"random", so passing the DeterministicTime seam as a
+    # parameter named `time` (the natural name) was misread as the
+    # stdlib module
+    out = run_on(DeterminismPass(), det_cfg(), **{
+        "simroot.py": SIM_ROOT,
+        "simmod.py": "def run(time):\n    return time.monotonic()\n",
+    })
+    assert out == []
+
+
+def test_determinism_prod_only_allowlist_and_clock_seam_skip():
+    files = {
+        "simroot.py": "import simmod\nimport clockmod\n",
+        "simmod.py": "import time\n\ndef now():\n    return time.time()\n",
+        "clockmod.py": "import time\n\ndef now():\n"
+                       "    return time.monotonic()\n",
+    }
+    out = run_on(DeterminismPass(), det_cfg(
+        prod_only={"simmod.py": "prod sink, sim never constructs it"},
+        clock_seam=frozenset({"clockmod.py"}),
+    ), **files)
+    assert out == []
+    # without the allowlist both modules fail
+    out = run_on(DeterminismPass(), det_cfg(), **files)
+    assert len(out) == 2
+
+
+def test_determinism_executor_seam_may_construct_threads():
+    files = {
+        "simroot.py": SIM_ROOT,
+        "simmod.py": "import threading\n\ndef spawn(fn):\n"
+                     "    threading.Thread(target=fn).start()\n",
+    }
+    out = run_on(DeterminismPass(), det_cfg(
+        executor_seam={"simmod.py": "IS the seam"}), **files)
+    assert out == []
+
+
+# ----------------------------------------------------------------------
+# copyhound v2: host<->device sync inducers
+# ----------------------------------------------------------------------
+
+def ch_cfg():
+    return cfg(copyhound_dirs=("pkg/",), kernel_holders=("self.kernels",))
+
+
+def test_copyhound_clean_device_code_passes():
+    out = run_on(CopyhoundPass(), ch_cfg(), **{
+        "pkg/k.py": "import jax.numpy as jnp\n\n"
+                    "def step(x):\n    return jnp.cumsum(x)\n",
+    })
+    assert out == []
+
+
+@pytest.mark.parametrize("body,check", [
+    # explicit sync calls, by name
+    ("def pull(x):\n    return np.asarray(x)\n", "asarray"),
+    ("def fence(x):\n    x.block_until_ready()\n", "block_until_ready"),
+    ("def pull(x):\n    return jax.device_get(x)\n", "device_get"),
+    ("def wire(x):\n    return x.tobytes()\n", "tobytes"),
+    ("def one(x):\n    return x.item()\n", "item"),
+    # implicit inducers via the taint walk
+    ("import jax.numpy as jnp\n\ndef total(x):\n"
+     "    t = jnp.sum(x)\n    return float(t)\n", "coerce"),
+    ("import jax.numpy as jnp\nimport numpy as np\n\ndef mix(x):\n"
+     "    t = jnp.cumsum(x)\n    return np.maximum(t, 0)\n",
+     "np-on-device"),
+    # review fix: keyword-passed device values induce the transfer too
+    ("import jax.numpy as jnp\nimport numpy as np\n\ndef kw(x):\n"
+     "    t = jnp.cumsum(x)\n    return np.sum(a=t)\n",
+     "np-on-device"),
+    ("import jax.numpy as jnp\n\ndef log(x):\n"
+     "    t = jnp.sum(x)\n    return f'total={t}'\n", "fstring"),
+    # kernel-bundle results are device values too
+    ("class Led:\n    def go(self, x):\n"
+     "        r = self.kernels.commit(x)\n        return int(r)\n",
+     "coerce"),
+])
+def test_copyhound_catches_sync_inducers(body, check):
+    out = run_on(CopyhoundPass(), ch_cfg(), **{"pkg/k.py": body})
+    assert check in checks_of(out), [v.render() for v in out]
+    assert all(v.site == f"pkg/k.py::{v.check}" for v in out)
+
+
+def test_copyhound_asarray_result_is_host_side():
+    # np.asarray IS the sync (one hit); using its result is clean — no
+    # cascading coerce/np-on-device/fstring hits downstream
+    out = run_on(CopyhoundPass(), ch_cfg(), **{
+        "pkg/k.py": "import jax.numpy as jnp\nimport numpy as np\n\n"
+                    "def drain(x):\n"
+                    "    t = jnp.cumsum(x)\n"
+                    "    h = np.asarray(t)\n"
+                    "    return float(h), np.maximum(h, 0), f'{h}'\n",
+    })
+    assert checks_of(out) == ["asarray"]
+    assert len(out) == 1
+
+
+def test_copyhound_jnp_asarray_result_stays_device_side():
+    # review fix: the _UNTAINTING leaf check fired before the jnp root
+    # check, so jnp.asarray — h2d STAGING, its result is a device
+    # array — was treated like np.asarray's host materialization and a
+    # downstream accidental d2h rode under the baselined asarray why
+    out = run_on(CopyhoundPass(), ch_cfg(), **{
+        "pkg/k.py": "import jax.numpy as jnp\n\n"
+                    "def stage(host_buf):\n"
+                    "    t = jnp.asarray(host_buf)\n"
+                    "    return float(t)\n",
+    })
+    # the staging upload counts under its OWN site key (asarray-h2d),
+    # so swapping it for a real np.asarray d2h can't hide in the count
+    assert checks_of(out) == ["asarray-h2d", "coerce"], \
+        [v.render() for v in out]
+
+
+def test_copyhound_sees_module_and_class_scope():
+    # review fix: v1's whole-tree walk caught module-level / class-body
+    # sync calls; v2's per-function taint walk must not narrow that
+    out = run_on(CopyhoundPass(), ch_cfg(), **{
+        "pkg/k.py": "import numpy as np\n\n"
+                    "LUT = np.asarray(range(8))\n\n"
+                    "class T:\n"
+                    "    TABLE = np.asarray(range(4))\n",
+    })
+    assert checks_of(out) == ["asarray"]
+    assert len(out) == 2
+
+
+def test_copyhound_scan_covers_the_commit_path_dirs():
+    config = cfg()
+    for d in ("ops", "models", "parallel", "vsr", "lsm", "cdc",
+              "ingress", "io"):
+        assert f"tigerbeetle_tpu/{d}/" in config.copyhound_dirs
+
+
+def test_copyhound_ignores_files_off_the_compute_path():
+    out = run_on(CopyhoundPass(), ch_cfg(), **{
+        "other/k.py": "def pull(x):\n    return np.asarray(x)\n",
+    })
+    assert out == []
+
+
+# ----------------------------------------------------------------------
+# tidy: source form + named noqa
+# ----------------------------------------------------------------------
+
+def test_tidy_named_noqa_suppresses_and_bare_noqa_fails():
+    out = run_on(TidyPass(), cfg(), **{
+        "tigerbeetle_tpu/x.py":
+            "import os  # noqa: unused-import\nX = 1\n",
+    })
+    assert out == []
+    bare = "import os  # noq" + "a\nX = 1\n"  # split: tidy scans THIS file
+    out = run_on(TidyPass(), cfg(), **{"tigerbeetle_tpu/x.py": bare})
+    # the bare marker is its own violation AND suppresses nothing
+    assert checks_of(out) == ["bare-noqa", "unused-import"]
+
+
+def test_tidy_noqa_naming_a_different_check_does_not_suppress():
+    out = run_on(TidyPass(), cfg(), **{
+        "tigerbeetle_tpu/x.py":
+            "import os  # noqa: library-print\nX = 1\n",
+    })
+    assert checks_of(out) == ["unused-import"]
+
+
+def test_tidy_source_form_checks():
+    out = run_on(TidyPass(), cfg(), **{
+        "tigerbeetle_tpu/x.py":
+            "X = 1 \nY = '\t'\nZ = '" + "z" * 120 + "'\n",
+    })
+    assert checks_of(out) == ["line-length", "tab", "trailing-whitespace"]
+
+
+def test_tidy_library_print_policy():
+    body = "def f():\n    print('hi')\n"
+    out = run_on(TidyPass(), cfg(), **{"tigerbeetle_tpu/x.py": body})
+    assert checks_of(out) == ["library-print"]
+    # user-facing surfaces and non-library code may print
+    for rel in ("tigerbeetle_tpu/cli.py", "scripts/x.py", "tests/x.py"):
+        out = run_on(TidyPass(), cfg(), **{rel: body})
+        assert out == [], rel
+
+
+# ----------------------------------------------------------------------
+# closed baselines
+# ----------------------------------------------------------------------
+
+def V(site, n=1):
+    from tigerbeetle_tpu.devtools.base import Violation
+
+    return [
+        Violation("f.py", i + 1, "p", "c", "msg", site=site)
+        for i in range(n)
+    ]
+
+
+def test_baseline_suppresses_explained_matching_sites():
+    base = {"f.py::c": {"site": "f.py::c", "count": 2, "why": "known"}}
+    assert apply_baseline("p", V("f.py::c", 2), base, "b.json") == []
+
+
+def test_baseline_empty_why_fails():
+    base = {"f.py::c": {"site": "f.py::c", "count": 1, "why": ""}}
+    out = apply_baseline("p", V("f.py::c", 1), base, "b.json")
+    assert [v.check for v in out] == ["baseline-why"]
+
+
+def test_baseline_new_site_and_excess_count_fail():
+    out = apply_baseline("p", V("f.py::c", 1), {}, "b.json")
+    assert [v.check for v in out] == ["c"]
+    base = {"f.py::c": {"site": "f.py::c", "count": 1, "why": "known"}}
+    out = apply_baseline("p", V("f.py::c", 3), base, "b.json")
+    assert [v.check for v in out] == ["c", "c"]  # only the excess
+
+
+def test_baseline_is_closed_in_both_directions():
+    base = {
+        "f.py::c": {"site": "f.py::c", "count": 2, "why": "known"},
+        "gone.py::c": {"site": "gone.py::c", "count": 1, "why": "known"},
+    }
+    out = apply_baseline("p", V("f.py::c", 1), base, "b.json")
+    # shrunk count AND vanished site both report as stale
+    assert [v.check for v in out] == ["baseline-stale", "baseline-stale"]
+    assert any("gone.py::c" in v.message for v in out)
+
+
+def test_baseline_update_keeps_whys_and_flags_new_sites(tmp_path):
+    path = tmp_path / "b.json"
+    old = {"a::x": {"site": "a::x", "count": 1, "why": "justified"}}
+    unexplained = save_baseline(path, {"a::x": 2, "b::y": 1}, old)
+    assert unexplained == 1  # b::y needs a human why before green
+    loaded = load_baseline(path)
+    assert loaded["a::x"]["why"] == "justified"
+    assert loaded["a::x"]["count"] == 2
+    assert loaded["b::y"]["why"] == ""
+
+
+def test_baseline_v1_schema_lifts_with_empty_whys(tmp_path):
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps({"a.py": {"asarray": 3}}))
+    loaded = load_baseline(path)
+    assert loaded == {
+        "a.py::asarray": {"site": "a.py::asarray", "count": 3, "why": ""},
+    }
+
+
+def test_repo_baselines_all_carry_whys():
+    for name in ("copyhound_baseline.json", "determinism_baseline.json"):
+        raw = json.loads((ROOT / "scripts" / name).read_text())
+        assert raw["version"] == 2, name
+        for e in raw["entries"]:
+            assert e["why"].strip(), f"{name}: {e['site']} has no why"
+
+
+# ----------------------------------------------------------------------
+# end-to-end: the real tree stays green (tier-1)
+# ----------------------------------------------------------------------
+
+def _run(script, *args):
+    return subprocess.run(
+        [sys.executable, f"scripts/{script}", *args], cwd=ROOT,
+        capture_output=True, text=True,
+    )
+
+
+def test_vet_whole_tree_green():
+    """All passes over the real tree: a new unannotated shared field,
+    sync inducer, nondeterminism source, or stale/unexplained baseline
+    entry fails tier-1 here."""
+    r = _run("vet.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "vet: clean" in r.stdout
+
+
+def test_vet_pass_selection_and_explain():
+    r = _run("vet.py", "--pass", "tidy,races")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "vet: clean (tidy, races)" in r.stdout
+    r = _run("vet.py", "--explain", "races")
+    assert r.returncode == 0
+    assert "owner" in r.stdout and "guarded-by" in r.stdout
+    r = _run("vet.py", "--explain", "copyhound/coerce")
+    assert r.returncode == 0 and "coerce" in r.stdout
+    r = _run("vet.py", "--explain", "copyhound/nope")
+    assert r.returncode == 1
+
+
+def test_vet_unknown_pass_name_is_a_clean_error():
+    # review fix: a typo'd --pass used to die with an AssertionError
+    # traceback (and a KeyError under python -O)
+    r = _run("vet.py", "--pass", "race")
+    assert r.returncode == 1
+    assert "unknown pass" in (r.stdout + r.stderr)
+    assert "Traceback" not in r.stderr, r.stderr
+
+
+def test_tidy_shim_clean():
     r = _run("tidy.py")
     assert r.returncode == 0, r.stdout
 
 
-def test_copyhound_clean():
+def test_copyhound_shim_clean():
     r = _run("copyhound.py")
     assert r.returncode == 0, r.stdout

@@ -29,6 +29,45 @@ from tigerbeetle_tpu.statsd import StatsD, StatsDEmitter, parse_addr
 from tigerbeetle_tpu.tracer import NULL_TRACER, JsonTracer
 
 
+# -- regression: cross-thread metric writes must not lose updates ------
+# (vet's races pass found the unguarded `value += v`: the WAL writer
+# pool, the spill IO worker, and the device-shadow loop all add into the
+# same registry counters the event loop is adding into — a thread switch
+# between a counter's read and store silently dropped increments)
+
+
+def test_counter_and_histogram_survive_concurrent_writers():
+    import sys
+    import threading
+
+    m = Metrics()
+    counter = m.counter("races.counter")
+    hist = m.histogram("races.hist")
+    threads_n, per_thread = 8, 5_000
+    start = threading.Barrier(threads_n)
+
+    def hammer():
+        start.wait()
+        for i in range(per_thread):
+            counter.add()
+            hist.observe(float(i % 64))
+
+    threads = [threading.Thread(target=hammer) for _ in range(threads_n)]
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)  # force preemption inside the +=
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sys.setswitchinterval(old_interval)
+    expect = threads_n * per_thread
+    assert counter.value == expect
+    assert hist.count == expect
+    assert sum(hist.counts) == expect
+
+
 # -- satellite: StatsD address parsing ---------------------------------
 
 

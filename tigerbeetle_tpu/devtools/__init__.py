@@ -1,0 +1,99 @@
+"""devtools: the vet static-analysis suite (driver: scripts/vet.py).
+
+One AST-based driver, pluggable passes, per-pass closed JSON baselines
+(reference: src/tidy.zig + src/copyhound.zig — analysis as build step):
+
+- tidy:        source form, unused imports, library prints, named noqa
+- copyhound:   host<->device sync inducers on the compute path
+- races:       thread-ownership lint over the five thread seams
+- determinism: sim-reachable code stays seed-deterministic
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from tigerbeetle_tpu.devtools.base import (
+    SourceFile,
+    VetPass,
+    Violation,
+    apply_baseline,
+    discover,
+    load_baseline,
+    load_files,
+    save_baseline,
+)
+from tigerbeetle_tpu.devtools.config import VetConfig, default_config
+from tigerbeetle_tpu.devtools.copyhound_pass import CopyhoundPass
+from tigerbeetle_tpu.devtools.determinism_pass import DeterminismPass
+from tigerbeetle_tpu.devtools.race_pass import RacePass
+from tigerbeetle_tpu.devtools.tidy_pass import TidyPass
+
+ALL_PASSES = (TidyPass, CopyhoundPass, RacePass, DeterminismPass)
+
+
+def make_passes(names: list[str] | None = None) -> list[VetPass]:
+    by_name = {p.name: p for p in ALL_PASSES}
+    if names is None:
+        return [p() for p in ALL_PASSES]
+    unknown = [n for n in names if n not in by_name]
+    if unknown:
+        raise SystemExit(
+            f"vet: unknown pass(es): {', '.join(unknown)} "
+            f"(have: {', '.join(sorted(by_name))})"
+        )
+    return [by_name[n]() for n in names]
+
+
+def baseline_path(config: VetConfig, p: VetPass) -> pathlib.Path | None:
+    if p.baseline_name is None:
+        return None
+    return config.root / "scripts" / p.baseline_name
+
+
+def run_pass(
+    p: VetPass,
+    files: list[SourceFile],
+    config: VetConfig,
+    update: bool = False,
+) -> tuple[list[Violation], str | None]:
+    """Run one pass through its baseline. Returns (violations, note);
+    with update=True the baseline is rewritten first (existing whys
+    carried over, new sites left unexplained so the run stays red until
+    a human fills them)."""
+    violations = p.run(files, config)
+    path = baseline_path(config, p)
+    if path is None:
+        return violations, None
+    note = None
+    old = load_baseline(path)
+    if update:
+        sites: dict[str, int] = {}
+        for v in violations:
+            if v.site:
+                sites[v.site] = sites.get(v.site, 0) + 1
+        unexplained = save_baseline(path, sites, old)
+        note = f"baseline written: {path.name} ({len(sites)} sites"
+        note += f", {unexplained} need a why)" if unexplained else ")"
+        old = load_baseline(path)
+    rel = str(path.relative_to(config.root))
+    return apply_baseline(p.name, violations, old, rel), note
+
+
+def run_vet(
+    root: pathlib.Path,
+    pass_names: list[str] | None = None,
+    update: bool = False,
+    config: VetConfig | None = None,
+) -> tuple[list[Violation], list[str]]:
+    """The whole suite over the repo tree. Returns (violations, notes)."""
+    config = config or default_config(root)
+    files = load_files(root, discover(root))
+    violations: list[Violation] = []
+    notes: list[str] = []
+    for p in make_passes(pass_names):
+        vs, note = run_pass(p, files, config, update=update)
+        violations.extend(vs)
+        if note:
+            notes.append(note)
+    return violations, notes

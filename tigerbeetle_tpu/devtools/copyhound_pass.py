@@ -1,0 +1,261 @@
+"""copyhound v2: host<->device sync inducers in the compute path.
+
+The reference's copyhound scans LLVM IR for accidental large memcpys
+(reference: src/copyhound.zig). The TPU analog of an accidental memcpy
+is an accidental DEVICE SYNC or host round-trip on the commit path: each
+one stalls dispatch (see ops/hashtable.py on why dispatch health is the
+flagship constraint).
+
+v2 extends the v1 scan (ops/ models/ parallel/) across the whole commit
+path — vsr/ lsm/ cdc/ ingress/ io/ — and adds the IMPLICIT inducers v1
+missed. Explicit sync calls are matched by name (`np.asarray`,
+`.block_until_ready()`, `jax.device_get`, `.tobytes()`, `.item()`,
+`from_dlpack`). Implicit inducers are found by a per-function taint
+walk: a value produced by `jnp.*` / `jax.*` / a jitted-kernel call (or
+read out of a device state dict) is DEVICE-tainted, and
+
+- `float()` / `int()` / `bool()` of a tainted value   -> "coerce"
+- any `np.*` call with a tainted argument             -> "np-on-device"
+- a tainted value interpolated into an f-string       -> "fstring"
+
+force a transfer the author may not have meant. `np.asarray(x)` yields
+a HOST value (that is the sync — counted under "asarray"), so downstream
+use of its result is clean.
+
+Every deliberate site lives in the closed baseline
+(scripts/copyhound_baseline.json) with a mandatory human `why`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tigerbeetle_tpu.devtools.base import (
+    SourceFile,
+    VetPass,
+    Violation,
+    dotted,
+)
+
+SYNC_CALLS = {
+    "asarray": "host materialization of a device array",
+    "block_until_ready": "explicit device fence",
+    "device_get": "explicit device->host transfer",
+    "tobytes": "host byte pull",
+    "from_dlpack": "host/device buffer handoff",
+    "item": "scalar device->host pull",
+}
+
+# jax entry points that do NOT produce device values
+_JAX_NON_ARRAY = {"jit", "named_scope", "profiler", "config", "devices"}
+
+# functions whose result is host-side even when the argument was tainted
+_UNTAINTING = {"asarray", "device_get", "tobytes", "item"}
+
+
+class _Taint(ast.NodeVisitor):
+    """Per-function device-taint walk. One level of local dataflow:
+    locals assigned from tainted expressions are tainted; state dicts
+    (locals assigned from `<x>.state`) taint their subscripts."""
+
+    def __init__(self, kernel_holders: set[str]):
+        self.kernel_holders = kernel_holders
+        self.tainted: set[str] = set()
+        self.state_dicts: set[str] = set()
+        self.hits: list[tuple[int, str, str]] = []  # (line, kind, detail)
+
+    # -- taint predicate ------------------------------------------------
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            d = dotted(node)
+            if d is not None and d.split(".")[0] in self.tainted:
+                return True
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id in self.state_dicts
+            ):
+                return True
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is not None:
+                root = d.split(".")[0]
+                leaf = d.split(".")[-1]
+                # jnp.* results are DEVICE arrays — including
+                # jnp.asarray, which is h2d staging, not the host
+                # materialization np.asarray is; checked before
+                # _UNTAINTING so the latter rule can't swallow it
+                if root == "jnp":
+                    return True
+                if root == "jax" and leaf not in _UNTAINTING and (
+                    len(d.split(".")) < 2
+                    or d.split(".")[1] not in _JAX_NON_ARRAY
+                ):
+                    return True
+                if leaf in _UNTAINTING:
+                    return False
+                holder = d.rsplit(".", 1)[0]
+                if holder in self.kernel_holders:
+                    return True
+            # a call ON a tainted value (x.astype, x.sum, ...) stays
+            # tainted unless the method itself untaints
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _UNTAINTING:
+                    return False
+                return self.is_tainted(node.func.value)
+            return False
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        return False
+
+    # -- assignment tracking --------------------------------------------
+
+    def _bind(self, target: ast.AST, tainted: bool, state: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+            if state:
+                self.state_dicts.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted, state)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        tainted = self.is_tainted(node.value)
+        state = (
+            isinstance(node.value, ast.Attribute)
+            and node.value.attr == "state"
+        )
+        for t in node.targets:
+            self._bind(t, tainted, state)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self.generic_visit(node)
+        if node.value is not None:
+            self._bind(
+                node.target,
+                self.is_tainted(node.value),
+                isinstance(node.value, ast.Attribute)
+                and node.value.attr == "state",
+            )
+
+    # nested defs are walked separately (ast.walk finds every
+    # FunctionDef) — do not double-count their bodies here
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- inducer detection ----------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in SYNC_CALLS:
+            kind = f.attr
+            if f.attr == "asarray" and dotted(f.value) == "jnp":
+                # h2d staging: a transfer worth counting, but not the
+                # d2h host materialization np.asarray is — a separate
+                # site key, so swapping a benign staging upload for a
+                # real host sync cannot hide under one baseline count
+                kind = "asarray-h2d"
+            self.hits.append((node.lineno, kind, kind))
+            return
+        if isinstance(f, ast.Name) and f.id in SYNC_CALLS:
+            self.hits.append((node.lineno, f.id, f.id))
+            return
+        # keyword-passed device values induce the same transfer as
+        # positional ones (`np.sum(a=t)`)
+        vals = list(node.args) + [kw.value for kw in node.keywords]
+        if isinstance(f, ast.Name) and f.id in ("float", "int", "bool"):
+            if any(self.is_tainted(a) for a in vals):
+                self.hits.append(
+                    (node.lineno, "coerce", f"{f.id}() of a device value")
+                )
+            return
+        d = dotted(f)
+        if d is not None and d.split(".")[0] == "np":
+            if any(self.is_tainted(a) for a in vals):
+                self.hits.append(
+                    (node.lineno, "np-on-device",
+                     f"{d}() applied to a device value")
+                )
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        self.generic_visit(node)
+        for part in node.values:
+            if isinstance(part, ast.FormattedValue) and self.is_tainted(
+                part.value
+            ):
+                self.hits.append(
+                    (node.lineno, "fstring", "device value in an f-string")
+                )
+
+
+class CopyhoundPass(VetPass):
+    name = "copyhound"
+    doc = __doc__
+    baseline_name = "copyhound_baseline.json"
+    checks = dict(
+        {k: f"explicit sync: {v}" for k, v in SYNC_CALLS.items()},
+        **{
+            "asarray-h2d": "explicit transfer: h2d staging upload "
+                           "(jnp.asarray — result stays on device)",
+            "coerce": "float()/int()/bool() coercion of a device value",
+            "np-on-device": "numpy ufunc/function applied to a jax array",
+            "fstring": "device array interpolated into an f-string",
+        },
+    )
+
+    def run(self, files: list[SourceFile], config) -> list[Violation]:
+        out: list[Violation] = []
+        for f in files:
+            if not any(f.rel.startswith(d) for d in config.copyhound_dirs):
+                continue
+            if f.tree is None:
+                continue  # tidy reports the syntax error
+            holders = set(config.kernel_holders)
+            # module scope (and, through it, class bodies — _Taint skips
+            # nested FunctionDefs) is a scope like any other: a sync call
+            # in a module-level constant or class attribute default must
+            # not vanish from the closed baseline just because it is not
+            # inside a def (v1's whole-tree walk caught these)
+            scopes: list[list] = [list(f.tree.body)]
+            for node in ast.walk(f.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scopes.append(node.body)
+            for body in scopes:
+                walker = _Taint(holders)
+                for stmt in body:
+                    walker.visit(stmt)
+                for line, kind, detail in walker.hits:
+                    out.append(
+                        Violation(
+                            f.rel, line, self.name, kind,
+                            f"host-device sync inducer: {detail} "
+                            "(justify in the baseline with a why, "
+                            "or remove)",
+                            site=f"{f.rel}::{kind}",
+                        )
+                    )
+        return out

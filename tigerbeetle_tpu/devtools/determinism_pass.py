@@ -1,0 +1,409 @@
+"""determinism: sim-reachable code must stay seed-deterministic.
+
+The VOPR's whole value rests on one property: same seed -> byte-identical
+runs (state checker digests, sim trace dumps, shrinker reproductions).
+PR 3/4/6 each re-proved it by hand after touching the pipeline; this
+pass machine-checks the sources of nondeterminism instead.
+
+Scope: the sim-reachable module set — the static import closure of
+`testing/simulator.py` and `scripts/vopr.py` over the package, minus the
+explicit prod-only allowlist in the config (modules the closure touches
+via imports but that only prod composition roots construct — each
+allowlist entry carries its reason). Within scope:
+
+- wall clocks (`time.time` / `monotonic` / `perf_counter` / `*_ns` /
+  `sleep`) are forbidden outside the clock seam (io/time.py) — sim time
+  comes from DeterministicTime ticks            [check: wall-clock]
+- unseeded randomness: module-level `random.*` calls, `random.Random()`
+  with no seed argument, `os.urandom`, `uuid.uuid4`
+                                               [check: unseeded-random]
+- iteration over a `set` (ids have no stable order; wrap in `sorted()`)
+  — detected for locals/attributes assigned from set literals/calls or
+  annotated `set[...]`                         [check: set-iteration]
+- direct `threading.Thread` / `ThreadPoolExecutor` construction outside
+  the executor seam modules (the ThreadedSpillIO/DeferredSpillIO seam
+  and the WAL writer pool) — thread timing must never reach sim state
+                                               [check: direct-thread]
+
+Deliberate sites (timing that feeds observability only, latency
+modeling, prod-gated threads) live in the closed baseline
+(scripts/determinism_baseline.json), each with a mandatory `why`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tigerbeetle_tpu.devtools.base import (
+    SourceFile,
+    VetPass,
+    Violation,
+    dotted,
+)
+
+WALL_CLOCK_FNS = {
+    "time", "monotonic", "perf_counter", "time_ns", "monotonic_ns",
+    "perf_counter_ns", "sleep",
+}
+
+
+def _module_of(rel: str) -> str | None:
+    """'tigerbeetle_tpu/vsr/journal.py' -> 'tigerbeetle_tpu.vsr.journal'"""
+    if not rel.endswith(".py"):
+        return None
+    mod = rel[:-3].replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def sim_closure(files: list[SourceFile], roots: list[str]) -> set[str]:
+    """Repo-relative paths of the package modules statically reachable
+    from the roots (imports anywhere in the file, including nested
+    function-level imports). Importing a name from a package pulls in
+    both the package __init__ and, when the name is itself a submodule,
+    that submodule."""
+    by_mod: dict[str, SourceFile] = {}
+    for f in files:
+        mod = _module_of(f.rel)
+        if mod is not None:
+            by_mod[mod] = f
+
+    def imports_of(f: SourceFile) -> set[str]:
+        # candidate dotted names; expanded to scanned modules below
+        raw: set[str] = set()
+        if f.tree is None:
+            return raw
+        mod = _module_of(f.rel)
+        is_pkg = f.rel.endswith("/__init__.py")
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    raw.add(alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module
+                if node.level > 0:
+                    # relative import: resolve against this module's
+                    # package (a package __init__'s first level is the
+                    # package itself)
+                    if mod is None:
+                        continue
+                    parts = mod.split(".")
+                    drop = node.level - 1 if is_pkg else node.level
+                    if drop >= len(parts):
+                        continue  # escapes the scanned tree
+                    pkg = parts[: len(parts) - drop]
+                    base = ".".join(pkg + ([base] if base else []))
+                if base is None:
+                    continue
+                raw.add(base)
+                for alias in node.names:
+                    raw.add(f"{base}.{alias.name}")
+        # importing a.b.c executes a/__init__ and a.b/__init__ too —
+        # every ancestor package in the file set is part of the closure
+        out: set[str] = set()
+        for name in raw:
+            parts = name.split(".")
+            for i in range(1, len(parts) + 1):
+                prefix = ".".join(parts[:i])
+                if prefix in by_mod:
+                    out.add(prefix)
+        return out
+
+    seen: set[str] = set()
+    queue: list[SourceFile] = [f for f in files if f.rel in roots]
+    # the roots themselves are in scope — the closure ANCHORS on them,
+    # it does not exempt them (vopr.py drawing from an unseeded RNG
+    # would defeat the lint as surely as any module it imports)
+    reached: set[str] = {f.rel for f in queue}
+    while queue:
+        f = queue.pop()
+        if f.rel in seen:
+            continue
+        seen.add(f.rel)
+        for mod in imports_of(f):
+            tgt = by_mod[mod]
+            reached.add(tgt.rel)
+            if tgt.rel not in seen:
+                queue.append(tgt)
+    return reached
+
+
+class _SetTypes(ast.NodeVisitor):
+    """Names/attributes assigned from set expressions (one level)."""
+
+    def __init__(self):
+        self.set_names: set[str] = set()  # 'x' or 'self.x'
+
+    def _target_key(self, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Name):
+            return node.id
+        d = dotted(node)
+        return d
+
+    def _is_set_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Set):
+            return True
+        if isinstance(node, ast.SetComp):
+            return True
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            return d == "set"
+        return False
+
+    def _is_set_ann(self, ann: ast.AST) -> bool:
+        if isinstance(ann, ast.Subscript):
+            ann = ann.value
+        if isinstance(ann, ast.Name):
+            return ann.id == "set"
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return ann.value.startswith("set")
+        return False
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._is_set_expr(node.value):
+            for t in node.targets:
+                key = self._target_key(t)
+                if key:
+                    self.set_names.add(key)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if self._is_set_ann(node.annotation) or (
+            node.value is not None and self._is_set_expr(node.value)
+        ):
+            key = self._target_key(node.target)
+            if key:
+                self.set_names.add(key)
+        self.generic_visit(node)
+
+    # nested defs are their own scope — walked separately, so a local
+    # set in one function cannot shadow-type a like-named local in
+    # another (self.* attribute keys are merged file-wide by the caller)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class DeterminismPass(VetPass):
+    name = "determinism"
+    doc = __doc__
+    baseline_name = "determinism_baseline.json"
+    checks = {
+        "wall-clock": "wall-clock call outside the io/time.py clock "
+                      "seam in sim-reachable code",
+        "unseeded-random": "unseeded randomness (random module fns, "
+                           "Random(), os.urandom, uuid4)",
+        "set-iteration": "iteration over a set — wrap in sorted() for "
+                         "a stable order",
+        "direct-thread": "Thread/ThreadPoolExecutor outside the "
+                         "executor seam modules",
+    }
+
+    def run(self, files: list[SourceFile], config) -> list[Violation]:
+        closure = sim_closure(files, config.sim_roots)
+        out: list[Violation] = []
+        for f in files:
+            if f.rel not in closure:
+                continue
+            if f.rel in config.prod_only:
+                continue
+            if f.rel in config.clock_seam:
+                continue
+            if f.tree is None:
+                continue
+            out.extend(self._check(f, config))
+        return out
+
+    def _check(self, f: SourceFile, config) -> list[Violation]:
+        out: list[Violation] = []
+        in_seam = f.rel in config.executor_seam
+        # aliases of the `time` module in this file (import time as
+        # _t) — seeded only by an actual import, so a parameter named
+        # `time` carrying the DeterministicTime clock seam (the natural
+        # name for it) is not misread as the stdlib module
+        time_aliases: set[str] = set()
+        random_aliases: set[str] = set()
+        # bare names bound by from-imports (`from time import
+        # perf_counter [as pc]`): local name -> original function
+        clock_names: dict[str, str] = {}
+        random_names: dict[str, str] = {}
+        entropy_names: dict[str, str] = {}
+        ENTROPY = {
+            ("os", "urandom"), ("uuid", "uuid4"),
+            ("secrets", "token_bytes"),
+        }
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        time_aliases.add(alias.asname or "time")
+                    if alias.name == "random":
+                        random_aliases.add(alias.asname or "random")
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if node.module == "time" and alias.name in WALL_CLOCK_FNS:
+                        clock_names[local] = alias.name
+                    if node.module == "random":
+                        random_names[local] = alias.name
+                    if (node.module, alias.name) in ENTROPY:
+                        entropy_names[local] = (
+                            f"{node.module}.{alias.name}"
+                        )
+        # per-scope set-typed names: a local `x = set()` in one function
+        # must not flag iteration over an unrelated `x` elsewhere;
+        # `self.x`-style dotted keys are attributes and stay file-wide
+        scopes: list[list] = [list(f.tree.body)]
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        attr_set_names: set[str] = set()
+        local_set_names: list[set[str]] = []
+        for body in scopes:
+            st = _SetTypes()
+            for stmt in body:
+                st.visit(stmt)
+            attr_set_names |= {n for n in st.set_names if "." in n}
+            local_set_names.append(
+                {n for n in st.set_names if "." not in n}
+            )
+
+        def emit(line, check, msg, detail):
+            out.append(
+                Violation(
+                    f.rel, line, self.name, check, msg,
+                    site=f"{f.rel}::{check}::{detail}",
+                )
+            )
+
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d is not None:
+                    parts = d.split(".")
+                    if (
+                        len(parts) == 2
+                        and parts[0] in time_aliases
+                        and parts[1] in WALL_CLOCK_FNS
+                    ):
+                        emit(
+                            node.lineno, "wall-clock",
+                            f"{d}() in sim-reachable code — route "
+                            "through the Time seam (io/time.py) or "
+                            "baseline with a why",
+                            parts[1],
+                        )
+                    if (
+                        len(parts) == 2
+                        and parts[0] in random_aliases
+                        and parts[1] != "Random"
+                    ):
+                        emit(
+                            node.lineno, "unseeded-random",
+                            f"{d}() uses the shared unseeded RNG — "
+                            "thread a random.Random(seed) through",
+                            parts[1],
+                        )
+                    if (
+                        len(parts) == 2
+                        and parts[0] in random_aliases
+                        and parts[1] == "Random"
+                        and not node.args
+                        and not node.keywords
+                    ):
+                        emit(
+                            node.lineno, "unseeded-random",
+                            "random.Random() without a seed",
+                            "Random",
+                        )
+                    if d in ("os.urandom", "uuid.uuid4", "secrets.token_bytes"):
+                        emit(
+                            node.lineno, "unseeded-random",
+                            f"{d}() is entropy, not simulation",
+                            parts[-1],
+                        )
+                    if len(parts) == 1:
+                        name = parts[0]
+                        if name in clock_names:
+                            emit(
+                                node.lineno, "wall-clock",
+                                f"{name}() (from-import of "
+                                f"time.{clock_names[name]}) in "
+                                "sim-reachable code — route through "
+                                "the Time seam (io/time.py) or "
+                                "baseline with a why",
+                                clock_names[name],
+                            )
+                        if name in random_names:
+                            orig = random_names[name]
+                            if orig != "Random":
+                                emit(
+                                    node.lineno, "unseeded-random",
+                                    f"{name}() (from-import of "
+                                    f"random.{orig}) uses the shared "
+                                    "unseeded RNG — thread a "
+                                    "random.Random(seed) through",
+                                    orig,
+                                )
+                            elif not node.args and not node.keywords:
+                                emit(
+                                    node.lineno, "unseeded-random",
+                                    "Random() without a seed",
+                                    "Random",
+                                )
+                        if name in entropy_names:
+                            emit(
+                                node.lineno, "unseeded-random",
+                                f"{name}() "
+                                f"({entropy_names[name]}) is "
+                                "entropy, not simulation",
+                                name,
+                            )
+                    leaf = parts[-1]
+                    if leaf in ("Thread", "ThreadPoolExecutor") and not in_seam:
+                        emit(
+                            node.lineno, "direct-thread",
+                            f"{d}() in sim-reachable code bypasses the "
+                            "spill/WAL executor seam — thread timing "
+                            "must never reach sim state",
+                            leaf,
+                        )
+        # for x in <set>: / comprehensions over a set — checked per
+        # scope so one function's set local cannot taint another's
+        def scope_walk(body):
+            stack = list(body)
+            while stack:
+                n = stack.pop()
+                yield n
+                for c in ast.iter_child_nodes(n):
+                    if not isinstance(
+                        c, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        stack.append(c)
+
+        for body, names in zip(scopes, local_set_names):
+            in_scope = names | attr_set_names
+            for node in scope_walk(body):
+                iters: list[ast.AST] = []
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    iters.append(node.iter)
+                elif isinstance(
+                    node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                           ast.GeneratorExp)
+                ):
+                    iters.extend(g.iter for g in node.generators)
+                for it in iters:
+                    key = (
+                        dotted(it) if not isinstance(it, ast.Name)
+                        else it.id
+                    )
+                    if key is not None and key in in_scope:
+                        emit(
+                            it.lineno, "set-iteration",
+                            f"iteration over set `{key}` has no "
+                            "stable order — wrap in sorted()",
+                            key.split(".")[-1],
+                        )
+        return out

@@ -1,0 +1,618 @@
+"""races: thread-ownership lint over the codebase's thread seams.
+
+The system has five deliberate thread seams (the WAL writer pool, the
+spill IO executor, the device-shadow loop, the CDC pump, and the
+ingress/bus event loop). Every recent PR hand-verified in review that
+shared mutable state crossing those seams is lock- or handoff-protected;
+this pass turns that review into CI.
+
+Annotation vocabulary (a `# vet:` comment on the attribute's assignment
+line, or on its own line directly above it):
+
+- `# vet: owner=<thread>`      the attribute belongs to one thread;
+                               every access from another thread fails.
+- `# vet: guarded-by=<attr>`   writes must happen inside a lexical
+                               `with self.<attr>:` scope (or a `with`
+                               over a local derived from `self.<attr>`,
+                               the per-sector-lock pattern). Lock-free
+                               reads are allowed — the double-checked
+                               registry pattern stays legal, at the
+                               reader's own staleness risk.
+- `# vet: handoff`             the attribute crosses threads through a
+                               declared handoff discipline (queue,
+                               fence, join-before-read); the pass
+                               trusts the declaration.
+
+For each class in the scanned seam modules the pass:
+
+1. builds a per-attribute access map across every method body (nested
+   functions included; `self.x = ...`, `self.x += ...`, `self.x[k] =
+   ...` and mutating method calls like `self.x.append(...)` count as
+   writes);
+2. infers each method's executing thread from the seam entry points —
+   `threading.Thread(target=self.m, name=...)`, executor
+   `submit(self.m)` / `submit(nested_fn)` (including one level of
+   submit-forwarder methods), and `add_done_callback` (callbacks run on
+   the completing worker thread). Everything else runs on "main" (the
+   event loop); `__init__` is construction and is exempt;
+3. fails any attribute written from two threads — or written from one
+   and read from another — without a `guarded-by` lock held at the
+   writes, a matching `owner`, or a declared `handoff`.
+
+Thread names: `main`, `thread:<name>` (or the literal Thread name),
+`worker:<executor attr>`, `callback`. Config `thread_aliases` maps
+human annotation names (e.g. `event-loop`) onto inferred names.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tigerbeetle_tpu.devtools.base import (
+    SourceFile,
+    VetPass,
+    Violation,
+    dotted,
+    self_attr,
+)
+
+# method names on `self.<attr>.<m>(...)` that mutate the attribute
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "discard",
+    "pop", "popleft", "popitem", "clear", "update", "add", "set",
+    "setdefault", "put", "put_nowait", "observe", "sort", "reverse",
+    "write", "start_thread",
+}
+
+# method names that submit a callable onto another thread
+SUBMITTERS = {"submit", "submit_io", "_io_submit", "_submit"}
+
+MAIN = "main"
+CALLBACK = "callback"
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    write: bool
+    line: int
+    locks: frozenset  # self-attrs whose locks are lexically held
+    method: str       # qualified method name (for messages)
+
+
+@dataclasses.dataclass
+class _Method:
+    qualname: str
+    private: bool
+    accesses: list
+    calls: set          # self.<m>() call targets
+    spawned: bool = False
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method (or nested function) body: accesses, calls, spawns."""
+
+    def __init__(self, cls: "_ClassScan", qualname: str):
+        self.cls = cls
+        self.qualname = qualname
+        self.accesses: list[Access] = []
+        self.calls: set[str] = set()
+        self.locks: list[str] = []      # with-stack of held lock attrs
+        self.local_src: dict[str, set[str]] = {}  # local -> self attrs
+        # Lambda nodes claimed as spawn args (their accesses were
+        # recorded on the SPAWN thread; visit_Lambda must not re-record
+        # them on the enclosing thread, where they never run)
+        self.claimed_lambdas: set[int] = set()
+
+    def _held(self) -> frozenset:
+        return frozenset(self.locks)
+
+    def _access(self, attr: str, write: bool, line: int) -> None:
+        self.accesses.append(
+            Access(attr, write, line, self._held(), self.qualname)
+        )
+
+    # -- expression-level read/write classification ---------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self_attr(node)
+        if attr is not None:
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self._access(attr, write, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = self_attr(node.target)
+        if attr is not None:
+            self._access(attr, True, node.lineno)
+        sub = node.target
+        if isinstance(sub, ast.Subscript):
+            attr = self_attr(sub.value)
+            if attr is not None:
+                self._access(attr, True, node.lineno)
+            # reads inside the index (`self.buf[self.head] += 1`)
+            self.visit(sub.slice)
+        # visit (not generic_visit): the RHS may BE a self-attribute
+        # (`self.total += self.base`) — generic_visit would dispatch
+        # only on its children and drop the read
+        self.visit(node.value)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            attr = self_attr(node.value)
+            if attr is not None:
+                self._access(attr, True, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        # self.<attr>.<mutator>(...) is a write to <attr>
+        if isinstance(f, ast.Attribute):
+            attr = self_attr(f.value)
+            if attr is not None and f.attr in MUTATORS:
+                self._access(attr, True, node.lineno)
+            # self.<m>(...) is an intra-class call edge
+            if attr is None and self_attr(f) is not None:
+                self.calls.add(f.attr)
+        # executor submit / thread spawn / callbacks — outside the
+        # Attribute branch: `from threading import Thread` spawns with a
+        # bare `Thread(...)` Name call
+        self.cls.scan_spawn(self, node)
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        held = []
+        for item in node.items:
+            expr = item.context_expr
+            # self_attr is None for calls (`with self.hist.time():`) —
+            # only a bare `self.<attr>` or a lock-derived local counts
+            attr = self_attr(expr)
+            if attr is None and isinstance(expr, ast.Name):
+                held.extend(self.local_src.get(expr.id, ()))
+            elif attr is not None:
+                held.append(attr)
+            # visiting the context expr still records its read
+            self.visit(expr)
+        self.locks.extend(held)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in held:
+            self.locks.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # track locals derived from self attrs (per-sector lock pattern:
+        # `lock = self._sector_locks.setdefault(...)` -> `with lock:`)
+        src_attrs = {
+            self_attr(n)
+            for n in ast.walk(node.value)
+            if self_attr(n) is not None
+        }
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self.local_src[t.id] = {a for a in src_attrs if a}
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested function: its own pseudo-method; thread decided by how
+        # the enclosing body uses it (spawn args) or inherits the parent
+        self.cls.add_nested(self, node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        if id(node) in self.claimed_lambdas:
+            return  # runs on the spawn thread; recorded there already
+        # dispatch on the body expression itself (generic_visit would
+        # visit only its children, dropping e.g. a mutator Call at the
+        # top level of `lambda f: self._pending.discard(f)`)
+        self.visit(node.body)
+
+
+class _ClassScan:
+    def __init__(self, node: ast.ClassDef, forwarders: set[str],
+                 thread_names: frozenset = frozenset()):
+        self.node = node
+        self.forwarders = forwarders
+        # file-level names bound to threading.Thread (`from threading
+        # import Thread [as T]`) — beyond the `.Thread` leaf heuristic
+        self.thread_names = thread_names
+        self.methods: dict[str, _Method] = {}
+        # pseudo-method qualname -> entry threads from spawn points
+        self.entries: dict[str, set[str]] = {}
+        self.nested_parent: dict[str, str] = {}
+        self._scan()
+
+    # -- spawn-point recognition ----------------------------------------
+
+    def _callable_target(self, arg: ast.AST) -> str | None:
+        """'m' for self.m, '<local fn name>' for a bare name."""
+        attr = self_attr(arg)
+        if attr is not None:
+            return attr
+        if isinstance(arg, ast.Name):
+            return arg.id
+        # self.<attr>.<method> (e.g. self._pending.discard): a bound
+        # method of an attribute — record as a callback ACCESS instead
+        return None
+
+    def note_spawn_args(self, scan: "_MethodScan", node: ast.Call,
+                        thread: str) -> None:
+        # only the FIRST positional arg is the callable — the rest are
+        # data whose names must not be misread as spawn targets
+        # (`submit(self._job, flush)` where `flush` is also a method)
+        for arg in node.args[:1]:
+            target = self._callable_target(arg)
+            if target is not None:
+                self.entries.setdefault(
+                    scan.qualname.split(".")[0] + "." + target
+                    if target not in self.node_method_names else target,
+                    set(),
+                ).add(thread)
+            elif isinstance(arg, ast.Attribute):
+                # bound method of an attribute: the call mutates/reads
+                # that attribute on the spawn thread
+                owner = self_attr(arg.value)
+                if owner is not None:
+                    scan.accesses.append(
+                        Access(
+                            owner, arg.attr in MUTATORS, node.lineno,
+                            frozenset(), f"{thread}-callback",
+                        )
+                    )
+            elif isinstance(arg, ast.Lambda):
+                # inline callback: its body executes on the spawn
+                # thread — scan it there, and mark it so the enclosing
+                # method's walk does not also claim it for ITS thread
+                sub = _MethodScan(self, f"{scan.qualname}.<lambda>")
+                sub.local_src = dict(scan.local_src)
+                sub.visit(arg.body)
+                for a in sub.accesses:
+                    scan.accesses.append(
+                        Access(a.attr, a.write, a.line, a.locks,
+                               f"{thread}-callback")
+                    )
+                scan.calls |= sub.calls
+                scan.claimed_lambdas.add(id(arg))
+
+    def scan_spawn(self, scan: "_MethodScan", node: ast.Call) -> None:
+        d = dotted(node.func)
+        # threading.Thread(target=self.m, name="x") — by dotted leaf, or
+        # by a from-import binding (incl. aliased) collected per file
+        if d is not None and (
+            d.split(".")[-1] == "Thread" or d in self.thread_names
+        ):
+            target = None
+            name = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = self._callable_target(kw.value)
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    name = str(kw.value.value)
+            if target is None:
+                # positional target: threading.Thread(group, target,
+                # ...) puts the callable second; Thread-like wrappers
+                # often take it first
+                for arg in node.args[1:2] + node.args[:1]:
+                    target = self._callable_target(arg)
+                    if target is not None:
+                        break
+            if target is not None:
+                thread = name or f"thread:{target}"
+                self.entries.setdefault(target, set()).add(thread)
+            return
+        if not isinstance(node.func, ast.Attribute):
+            return
+        meth = node.func.attr
+        if meth in SUBMITTERS | self.forwarders:
+            ex = self_attr(node.func.value)
+            if ex is None and isinstance(node.func.value, ast.Name):
+                if node.func.value.id == "self":
+                    # self.<submit-forwarder>(fn): name the worker after
+                    # the forwarder — one stable name per seam
+                    ex = meth
+                else:
+                    srcs = scan.local_src.get(node.func.value.id, set())
+                    ex = next(iter(sorted(srcs)), None)
+            thread = f"worker:{ex}" if ex else "worker"
+            self.note_spawn_args(scan, node, thread)
+        elif meth == "add_done_callback":
+            self.note_spawn_args(scan, node, CALLBACK)
+
+    # -- scanning --------------------------------------------------------
+
+    def add_nested(self, parent: "_MethodScan", node: ast.FunctionDef):
+        qual = f"{parent.qualname}.{node.name}"
+        scan = _MethodScan(self, qual)
+        scan.local_src = dict(parent.local_src)
+        for stmt in node.body:
+            scan.visit(stmt)
+        self.methods[qual] = _Method(
+            qual, True, scan.accesses, scan.calls
+        )
+        self.nested_parent[qual] = parent.qualname
+        # record the local name so spawn args can find it
+        parent.local_src.setdefault(node.name, set())
+
+    def _scan(self) -> None:
+        self.node_method_names = {
+            n.name
+            for n in self.node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for n in self.node.body:
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            scan = _MethodScan(self, n.name)
+            for stmt in n.body:
+                scan.visit(stmt)
+            self.methods[n.name] = _Method(
+                n.name, n.name.startswith("_"), scan.accesses, scan.calls
+            )
+
+    # -- thread propagation ----------------------------------------------
+
+    def method_threads(self) -> dict[str, set[str]]:
+        threads: dict[str, set[str]] = {
+            q: set() for q in self.methods
+        }
+        spawned: set[str] = set()
+        for target, ts in self.entries.items():
+            if target.startswith("@"):
+                continue
+            # resolve: plain method name, or nested qualname suffix
+            for q in self.methods:
+                if q == target or q.endswith("." + target):
+                    threads[q] |= ts
+                    spawned.add(q)
+        # non-spawned, non-nested methods are callable from the event
+        # loop; nested ones inherit their parent (resolved below)
+        for q in self.methods:
+            if q in spawned:
+                continue
+            if q in self.nested_parent:
+                continue  # inherits via call/parent propagation
+            threads[q].add(MAIN)
+        # nested, never-spawned functions run where their parent runs
+        for q, parent in self.nested_parent.items():
+            if q not in spawned:
+                threads[q] |= threads.get(parent, {MAIN})
+        # propagate along intra-class call edges to a fixed point
+        changed = True
+        while changed:
+            changed = False
+            for q, m in self.methods.items():
+                for callee in m.calls:
+                    for q2 in self.methods:
+                        if q2 == callee or q2.endswith("." + callee):
+                            if not threads[q] <= threads[q2]:
+                                threads[q2] |= threads[q]
+                                changed = True
+            for q, parent in self.nested_parent.items():
+                if q not in spawned and not threads[parent] <= threads[q]:
+                    threads[q] |= threads[parent]
+                    changed = True
+        self.spawned = spawned
+        return threads
+
+
+def _parse_vet_decl(text: str) -> dict[str, str] | None:
+    """'owner=x' / 'guarded-by=y' / 'handoff' -> key/value dict, or
+    None when the declaration does not parse."""
+    out: dict[str, str] = {}
+    for token in text.replace(",", " ").split():
+        if token == "handoff":
+            out["handoff"] = "yes"
+        elif "=" in token:
+            k, v = token.split("=", 1)
+            if k not in ("owner", "guarded-by") or not v:
+                return None
+            out[k] = v
+        else:
+            return None
+    return out or None
+
+
+class RacePass(VetPass):
+    name = "races"
+    doc = __doc__
+    baseline_name = "races_baseline.json"
+    checks = {
+        "unannotated-shared": "attribute crosses threads with no "
+                              "owner/guarded-by/handoff declaration",
+        "owner": "attribute accessed off its declared owner thread",
+        "guarded-by": "attribute written outside its declared lock",
+        "bad-annotation": "malformed or unresolvable `# vet:` "
+                          "declaration",
+    }
+
+    def run(self, files: list[SourceFile], config) -> list[Violation]:
+        out: list[Violation] = []
+        for f in files:
+            if f.rel not in config.race_scan:
+                continue
+            if f.tree is None:
+                continue
+            decls, bad = self._decls(f)
+            out.extend(bad)
+            thread_names = self._thread_names(f)
+            for node in f.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    out.extend(
+                        self._check_class(
+                            f, node, decls, config, thread_names
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _thread_names(f: SourceFile) -> frozenset:
+        """Local names bound to threading.Thread by from-imports."""
+        names = set()
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "threading":
+                for alias in node.names:
+                    if alias.name == "Thread":
+                        names.add(alias.asname or alias.name)
+        return frozenset(names)
+
+    # -- annotation collection -------------------------------------------
+
+    def _decls(self, f: SourceFile):
+        """(line -> decl dict) for every `# vet:` comment; malformed
+        ones become violations."""
+        decls: dict[int, dict[str, str]] = {}
+        bad: list[Violation] = []
+        for line, text in f.vet_comments().items():
+            d = _parse_vet_decl(text)
+            if d is None:
+                bad.append(
+                    Violation(
+                        f.rel, line, self.name, "bad-annotation",
+                        f"cannot parse `# vet: {text}` — expected "
+                        "owner=<thread>, guarded-by=<attr>, or handoff",
+                    )
+                )
+            else:
+                decls[line] = d
+        return decls, bad
+
+    def _attr_decl(
+        self, f, decls, assign_lines: dict[str, list[int]]
+    ) -> tuple[dict[str, dict], list[Violation]]:
+        """Attach each vet declaration to the attribute assigned on its
+        line (or on the first assignment line directly below a
+        standalone comment line)."""
+        per_attr: dict[str, dict] = {}
+        out: list[Violation] = []
+        line_to_attr: dict[int, str] = {}
+        for attr, lines in assign_lines.items():
+            for ln in lines:
+                line_to_attr.setdefault(ln, attr)
+        for line, d in sorted(decls.items()):
+            attr = line_to_attr.get(line)
+            if attr is None:
+                # standalone comment: applies to the next assignment
+                # within the following 2 lines
+                for probe in (line + 1, line + 2):
+                    attr = line_to_attr.get(probe)
+                    if attr is not None:
+                        break
+            if attr is None:
+                continue  # not attached to this class's attrs
+            prev = per_attr.get(attr)
+            if prev is not None and prev != d:
+                out.append(
+                    Violation(
+                        f.rel, line, self.name, "bad-annotation",
+                        f"conflicting vet declarations for `{attr}`",
+                    )
+                )
+            per_attr[attr] = d
+        return per_attr, out
+
+    # -- per-class check --------------------------------------------------
+
+    def _check_class(self, f, node: ast.ClassDef, decls, config,
+                     thread_names: frozenset = frozenset()):
+        out: list[Violation] = []
+        scan = _ClassScan(node, forwarders=set(config.submit_forwarders),
+                          thread_names=thread_names)
+        threads = scan.method_threads()
+        aliases = config.thread_aliases
+
+        # attribute universe + assignment lines (declaration sites)
+        assign_lines: dict[str, list[int]] = {}
+        for q, m in scan.methods.items():
+            for a in m.accesses:
+                if a.write:
+                    assign_lines.setdefault(a.attr, []).append(a.line)
+        for n in node.body:  # class-level declarations
+            targets = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, ast.AnnAssign):
+                targets = [n.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    assign_lines.setdefault(t.id, []).append(n.lineno)
+
+        per_attr, bad = self._attr_decl(f, decls, assign_lines)
+        out.extend(bad)
+
+        # collect accesses per attribute with resolved threads;
+        # __init__ is construction — exempt. A nested def under it is
+        # exempt only while it stays un-spawned: `def loop(): ...;
+        # Thread(target=loop)` in a constructor runs on the spawned
+        # thread later, not at construction time
+        acc: dict[str, list[tuple[str, Access]]] = {}
+        for q, m in scan.methods.items():
+            if q == "__init__" or (
+                q.startswith("__init__.") and q not in scan.spawned
+            ):
+                continue
+            for a in m.accesses:
+                ts = threads.get(q) or {MAIN}
+                if a.method.endswith("-callback"):
+                    ts = {a.method.rsplit("-", 1)[0]}
+                for t in ts:
+                    acc.setdefault(a.attr, []).append((t, a))
+
+        for attr, pairs in sorted(acc.items()):
+            decl = per_attr.get(attr, {})
+            if "handoff" in decl:
+                continue
+            write_threads = {t for t, a in pairs if a.write}
+            all_threads = {t for t, a in pairs}
+            if "guarded-by" in decl:
+                lock = decl["guarded-by"]
+                if lock not in assign_lines:
+                    out.append(
+                        Violation(
+                            f.rel, min(assign_lines.get(attr, [0])),
+                            self.name, "bad-annotation",
+                            f"`{attr}` guarded-by `{lock}` but no such "
+                            "attribute exists on the class",
+                        )
+                    )
+                    continue
+                for t, a in pairs:
+                    if a.write and lock not in a.locks:
+                        out.append(
+                            Violation(
+                                f.rel, a.line, self.name, "guarded-by",
+                                f"`self.{attr}` written in {a.method} "
+                                f"without holding self.{lock} "
+                                f"(declared guarded-by)",
+                            )
+                        )
+                continue
+            if "owner" in decl:
+                owner = aliases.get(decl["owner"], decl["owner"])
+                for t, a in pairs:
+                    if t != owner:
+                        out.append(
+                            Violation(
+                                f.rel, a.line, self.name, "owner",
+                                f"`self.{attr}` accessed from thread "
+                                f"`{t}` in {a.method} but declared "
+                                f"owner={decl['owner']}",
+                            )
+                        )
+                continue
+            # no annotation: flag cross-thread mutation
+            if write_threads and len(all_threads) > 1:
+                lines = sorted({a.line for _, a in pairs if a.write})
+                out.append(
+                    Violation(
+                        f.rel, lines[0], self.name,
+                        "unannotated-shared",
+                        f"`{node.name}.{attr}` is written on "
+                        f"{sorted(write_threads)} and accessed on "
+                        f"{sorted(all_threads)} with no vet "
+                        "annotation — declare owner=, guarded-by=, "
+                        "or handoff",
+                        site=f"{f.rel}::{node.name}.{attr}",
+                    )
+                )
+        return out

@@ -1,0 +1,129 @@
+"""tidy: source-form lint (the reference's src/tidy.zig analog).
+
+Checks every Python source in the repo:
+- no tabs, no trailing whitespace, lines <= 100 columns;
+- no unused imports (AST-verified);
+- `print()` only in user-facing surfaces (CLI/REPL/scripts/bench) —
+  library code logs or returns, it does not print;
+- `# noqa` must NAME the check it suppresses (`# noqa: unused-import`).
+  A bare `# noqa` is itself a violation: an unlabeled suppression hides
+  which rule it was meant to silence and survives the rule's removal.
+
+noqa names: this pass's own check ids suppress the matching check;
+flake8-style codes are accepted as names (so sources stay compatible
+with external linters) and `F401` aliases `unused-import`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tigerbeetle_tpu.devtools.base import SourceFile, VetPass, Violation
+
+NOQA_ALIASES = {"F401": "unused-import"}
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            n: ast.AST = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+class TidyPass(VetPass):
+    name = "tidy"
+    doc = __doc__
+    checks = {
+        "tab": "tab characters are banned (spaces only)",
+        "trailing-whitespace": "no trailing whitespace",
+        "line-length": "lines must fit in 100 columns",
+        "unused-import": "imports must be used (or `# noqa: "
+                         "unused-import` with a reason)",
+        "library-print": "print() only in CLI/REPL/scripts/bench "
+                         "surfaces; library code logs or returns",
+        "bare-noqa": "`# noqa` must name the check it suppresses",
+        "syntax": "every scanned source must parse",
+    }
+
+    def run(self, files: list[SourceFile], config) -> list[Violation]:
+        out: list[Violation] = []
+        for f in files:
+            out.extend(self._check(f, config))
+        return out
+
+    def _suppressed(self, noqa, line: int, check: str) -> bool:
+        names = noqa.get(line)
+        if not names:  # absent, or bare (bare suppresses nothing)
+            return False
+        names = {NOQA_ALIASES.get(n, n) for n in names}
+        return check in names
+
+    def _check(self, f: SourceFile, config) -> list[Violation]:
+        out: list[Violation] = []
+
+        def emit(line: int, check: str, message: str) -> None:
+            out.append(Violation(f.rel, line, self.name, check, message))
+
+        exempt_len = f.rel in config.line_max_exempt
+        for i, line in enumerate(f.lines, 1):
+            if "\t" in line:
+                emit(i, "tab", "tab character")
+            if line != line.rstrip():
+                emit(i, "trailing-whitespace", "trailing whitespace")
+            if len(line) > config.line_max and not exempt_len:
+                emit(
+                    i, "line-length",
+                    f"line exceeds {config.line_max} columns",
+                )
+        if f.parse_error is not None:
+            emit(
+                f.parse_error.lineno or 0, "syntax",
+                f"syntax error: {f.parse_error.msg}",
+            )
+            return out
+        noqa = f.noqa()
+        for i, names in sorted(noqa.items()):
+            if names is None:
+                emit(
+                    i, "bare-noqa",
+                    "bare `# noqa` — name the check it suppresses "
+                    "(e.g. `# noqa: unused-import`)",
+                )
+        tree = f.tree
+        used = _used_names(tree)
+        in_init = f.rel.endswith("__init__.py")
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)) and not in_init:
+                if (
+                    isinstance(node, ast.ImportFrom)
+                    and node.module == "__future__"
+                ):
+                    continue
+                if self._suppressed(noqa, node.lineno, "unused-import"):
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    name = (alias.asname or alias.name).split(".")[0]
+                    if name not in used:
+                        emit(
+                            node.lineno, "unused-import",
+                            f"unused import {name!r}",
+                        )
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and f.rel.startswith("tigerbeetle_tpu/")
+                and f.rel not in config.print_ok
+                and not self._suppressed(noqa, node.lineno, "library-print")
+            ):
+                emit(node.lineno, "library-print", "print() in library code")
+        return out

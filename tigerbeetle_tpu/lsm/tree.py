@@ -372,7 +372,7 @@ class Tree:
             if not unresolved:
                 return out
             cand = [
-                i for i in unresolved
+                i for i in sorted(unresolved)
                 if info.key_min <= keys[i] <= info.key_max
             ]
             if cand:

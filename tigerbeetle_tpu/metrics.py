@@ -40,18 +40,27 @@ BUCKETS = 27
 
 
 class Counter:
-    __slots__ = ("name", "unit", "value")
+    __slots__ = ("name", "unit", "value", "_lock")
 
     def __init__(self, name: str, unit: str = ""):
         self.name = name
         self.unit = unit
-        self.value = 0
+        # One counter is written from several seams at once (the WAL
+        # writer pool, the spill IO worker, the device-shadow loop,
+        # native-engine done-callbacks). `value += v` is three bytecodes
+        # — a thread switch between the read and the store LOSES an
+        # increment — so mutation takes the lock (vet: races found the
+        # unguarded cross-thread writes this protects against).
+        self._lock = threading.Lock()
+        self.value = 0  # vet: guarded-by=_lock
 
     def add(self, v=1) -> None:
-        self.value += v
+        with self._lock:
+            self.value += v
 
     def set(self, v) -> None:  # restore/rebind support
-        self.value = v
+        with self._lock:
+            self.value = v
 
 
 class Gauge:
@@ -91,23 +100,31 @@ class Histogram:
     top, within a factor of two elsewhere (the resolution the reference's
     statsd aggregation works at too)."""
 
-    __slots__ = ("name", "unit", "counts", "count", "total", "max")
+    __slots__ = ("name", "unit", "counts", "count", "total", "max", "_lock")
 
     def __init__(self, name: str, unit: str = "us"):
         self.name = name
         self.unit = unit
-        self.counts = [0] * (BUCKETS + 1)
-        self.count = 0
-        self.total = 0.0
-        self.max = 0.0
+        # Same cross-seam exposure as Counter: journal.write_us is
+        # observed from the WAL writer pool while the event loop observes
+        # it on the sync path — `count += 1` / `total += v` lose updates
+        # on a thread switch, so observe() takes the lock. Reads
+        # (percentile/snapshot) stay lock-free: counts never resizes, and
+        # a smeared in-flight observation only staleness-skews a report.
+        self._lock = threading.Lock()
+        self.counts = [0] * (BUCKETS + 1)  # vet: guarded-by=_lock
+        self.count = 0   # vet: guarded-by=_lock
+        self.total = 0.0  # vet: guarded-by=_lock
+        self.max = 0.0   # vet: guarded-by=_lock
 
     def observe(self, v: float) -> None:
-        self.count += 1
-        self.total += v
-        if v > self.max:
-            self.max = v
         i = int(v).bit_length()  # v <= 2**i for all v >= 0
-        self.counts[i if i <= BUCKETS else BUCKETS] += 1
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if v > self.max:
+                self.max = v
+            self.counts[i if i <= BUCKETS else BUCKETS] += 1
 
     def time(self) -> _Timed:
         return _Timed(self)

@@ -93,14 +93,22 @@ class DualLedger:
     def instrument(self, metrics, tracer) -> None:
         """Re-bind onto a shared registry/tracer (the replica's).
         Accumulated values carry over; the shadow loop reads
-        self.shadow_stats/self.tracer per use, so a rebind while the
-        thread runs is safe (worst case one update lands in the old
-        group)."""
+        self.shadow_stats/self.tracer per use. A shadow update racing
+        the carry-over/rebind window lands in the discarded old group
+        and is DROPPED from the new registry — at most one update, and
+        instrument() runs at setup before commits flow, so nothing of
+        record is lost."""
         for key in self.SHADOW_KEYS:
             metrics.counter(f"shadow.{key}").add(self.shadow_stats[key])
         self.metrics = metrics
-        self.tracer = tracer
-        self.shadow_stats = metrics.group("shadow", self.SHADOW_KEYS)
+        # rebound on the event loop while the shadow thread reads per
+        # use — a GIL-atomic reference swap, never a torn value; see the
+        # docstring for the (setup-time-only) dropped-update window
+        self.tracer = tracer  # vet: handoff
+        # registry-backed StatGroup; Counter.add serializes internally
+        self.shadow_stats = metrics.group(  # vet: handoff
+            "shadow", self.SHADOW_KEYS
+        )
         # the shadow DeviceLedger's own instrumentation (group staging
         # fence waits) reports into the same store
         self.device.instrument(metrics, tracer)
@@ -134,11 +142,14 @@ class DualLedger:
         self.process = None  # replica duck-typing (native backend shape)
         self.spill = None
         self.hazards = self.device.hazards  # [stats] observability
-        # chained digests of the dense reply-code stream (hash_log pair)
-        self._chk_native = 0
+        # chained digests of the dense reply-code stream (hash_log pair);
+        # folded on the native engine's done-callbacks, read at finalize
+        self._chk_native = 0  # vet: guarded-by=_chk_lock
         self._chk_lock = threading.Lock()
-        self._shadow_error: Exception | None = None
-        self._shadow_batches = 0
+        # written only by the shadow thread; finalize() joins the thread
+        # before reading either (join-before-read)
+        self._shadow_error: Exception | None = None  # vet: handoff
+        self._shadow_batches = 0  # vet: handoff
         # shadow-loop cost accounting (the h2d/staging tax shares the core
         # with the reply-serving event loop): stage_s = host time spent
         # staging + dispatching shadow work; idle_s = blocked on an empty
@@ -152,8 +163,12 @@ class DualLedger:
         self.metrics = Metrics()
         self.tracer = NULL_TRACER
         self.shadow_stats = self.metrics.group("shadow", self.SHADOW_KEYS)
-        self._restored = False  # device cannot follow a snapshot restore
-        self._q: queue.Queue = queue.Queue(maxsize=queue_max)
+        # device cannot follow a snapshot restore. Set on the event loop,
+        # polled by the shadow loop: a GIL-atomic bool flip whose one-
+        # iteration staleness only delays the stand-down by a batch
+        self._restored = False  # vet: handoff
+        # the queue IS the cross-thread handoff (bounded, blocking put)
+        self._q: queue.Queue = queue.Queue(maxsize=queue_max)  # vet: handoff
         self._thread = threading.Thread(
             target=self._shadow_loop, name="device-shadow", daemon=True
         )
@@ -369,7 +384,8 @@ class DualLedger:
                     i = j
             except Exception as e:  # divergence surfaces at finalize
                 self._shadow_error = e
-        self._chk_device_scalar = chk
+        # written once at shadow-loop exit; finalize() joins before reading
+        self._chk_device_scalar = chk  # vet: handoff
 
     def _enqueue_shadow(self, operation, timestamp: int, arr) -> None:
         # the queue bounds host-memory growth; a full queue briefly

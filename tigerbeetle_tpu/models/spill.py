@@ -325,12 +325,18 @@ class SpillManager:
     def instrument(self, metrics, tracer) -> None:
         """Re-bind onto a shared registry/tracer (the replica's, or the
         bench driver's). Accumulated values carry over; the forest's trees
-        and grid report into the same registry."""
+        and grid report into the same registry. A worker-side stat update
+        racing the carry-over/rebind window lands in the discarded old
+        group and is dropped from the new registry — at most one update,
+        and instrument() runs at setup before IO jobs flow."""
         for key in self.STAT_KEYS:
             metrics.counter(f"spill.{key}").add(self.stats[key])
         self.metrics = metrics
-        self.tracer = tracer
-        self.stats = metrics.group("spill", self.STAT_KEYS)
+        # rebound on the event loop while IO-worker jobs read per use —
+        # a GIL-atomic reference swap (worst case one span lands in the
+        # old tracer); registry counters serialize internally
+        self.tracer = tracer  # vet: handoff
+        self.stats = metrics.group("spill", self.STAT_KEYS)  # vet: handoff
         for tree in self.forest._trees():
             tree.metrics = metrics
             tree.tracer = tracer
@@ -375,7 +381,7 @@ class SpillManager:
         # rows in flight to the LSM sit in _staged (id -> (row, ful));
         # fetches check _staged first and barrier on the executor before
         # any direct forest read
-        self._staged: dict[int, tuple[np.ndarray, int]] = {}
+        self._staged: dict[int, tuple[np.ndarray, int]] = {}  # vet: guarded-by=_staged_lock
         self._staged_lock = threading.Lock()
         # one outstanding prefetch (consumed by the next reload) + its two
         # alternating host staging slots
@@ -943,9 +949,11 @@ class SpillManager:
         return b"".join(out)
 
     def extract_into(self, transfers: dict, posted: dict) -> None:
-        """Merge spilled rows into extract() results (parity surface)."""
+        """Merge spilled rows into extract() results (parity surface).
+        Sorted: dict insertion order is part of the extract surface
+        (parity dumps serialize it), and set order is not stable."""
         self.io_drain()
-        for id_ in self.spilled:
+        for id_ in sorted(self.spilled):
             row, ful = self._fetch(id_)
             t = types.Transfer.from_np(
                 np.frombuffer(row, dtype=types.TRANSFER_DTYPE)[0]

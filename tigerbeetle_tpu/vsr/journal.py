@@ -34,7 +34,11 @@ class Journal:
     tracer = NULL_TRACER
 
     def __init__(self, storage: Storage, cluster: ConfigCluster):
-        self.storage = storage
+        # crossed by the writer pool, but every concurrent write targets
+        # a disjoint region: prepare slots are op-owned, shared header
+        # SECTORS serialize through _sector_locks, and evidence surgery
+        # (invalidate_above/recover) quiesces the pool first
+        self.storage = storage  # vet: handoff
         self.cluster = cluster
         self.slot_count = cluster.journal_slot_count
         self.msg_max = cluster.message_size_max
@@ -48,13 +52,20 @@ class Journal:
         self._executor: ThreadPoolExecutor | None = None
         self._sector_locks: dict[int, threading.Lock] = {}
         self._locks_guard = threading.Lock()
-        self._pending_writes: set[Future] = set()
+        # add() on the event loop, discard() on the completing worker via
+        # add_done_callback — both GIL-atomic set ops; quiesce() snapshots
+        # with list() before iterating (join-before-read)
+        self._pending_writes: set[Future] = set()  # vet: handoff
         # Durable-header mirror: a slot's header enters this mirror (and
         # therefore reaches the redundant ring on disk) only AFTER its own
         # prepare write completed — a neighbor slot's sector write must
         # never publish a header whose prepare is still in flight (the
-        # prepare-before-header ordering contract, per slot).
-        self._headers_durable = bytearray(self.slot_count * HEADER_SIZE)
+        # prepare-before-header ordering contract, per slot). Worker
+        # writes hold the slot's sector lock; event-loop writes happen
+        # only on the sync path (no pool) or after quiesce()
+        self._headers_durable = bytearray(  # vet: handoff
+            self.slot_count * HEADER_SIZE
+        )
 
     def slot_for_op(self, op: int) -> int:
         return op % self.slot_count
@@ -142,7 +153,9 @@ class Journal:
             self._io_executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="journal-io"
             )
-            self._pending_io: set[Future] = set()
+            # same discipline as _pending_writes: GIL-atomic add/discard,
+            # drain_io() snapshots with list() (join-before-read)
+            self._pending_io: set[Future] = set()  # vet: handoff
         fut = self._io_executor.submit(fn, *args)
         self._pending_io.add(fut)
         fut.add_done_callback(self._pending_io.discard)
