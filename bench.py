@@ -550,8 +550,9 @@ def _median_e2e(stage, name: str, n_runs: int, log, trace: bool = False,
     as `trace_events` for the driver to merge into the --trace file."""
     from tigerbeetle_tpu.benchmark import run_e2e
 
-    dual = "+" in kw.get("backend", "native")
-    runs, shadows, last = [], [], None
+    backend = kw.get("backend", "native")
+    dual = "+" in backend or backend == "dual"
+    runs, shadows, hash_logs, hits, last = [], [], [], [], None
     trace_events = None
     for i in range(n_runs):
         kw_i = dict(kw, trace="server") if (trace and i == 0) else kw
@@ -560,6 +561,7 @@ def _median_e2e(stage, name: str, n_runs: int, log, trace: bool = False,
         if trace and i == 0:
             trace_events = last.pop("trace_events", None)
         runs.append(last["durable_tps"])
+        hits.append(last.get("group_commit_hit_rate"))
         if dual:
             # a run whose server died before printing [stats] has no
             # device_shadow at all — that is an UNVERIFIED run, not a
@@ -567,6 +569,7 @@ def _median_e2e(stage, name: str, n_runs: int, log, trace: bool = False,
             shadows.append(
                 last.get("device_shadow", {}).get("verified")
             )
+            hash_logs.append(last.get("device_hash_log_ok"))
     med = float(np.median(runs))
     out = dict(last)
     out["durable_tps"] = round(med, 1)
@@ -574,8 +577,18 @@ def _median_e2e(stage, name: str, n_runs: int, log, trace: bool = False,
     out["durable_spread"] = (
         round((max(runs) - min(runs)) / med, 4) if med else None
     )
+    # per-run fuse hit rates (the fuse-window regression's artifact:
+    # a single aggregated rate hid which segment/run had the bad window)
+    out["group_commit_hit_rate_runs"] = hits
     if dual:
         out["shadow_verified_all"] = all(v is True for v in shadows)
+        if backend == "dual":
+            # follower runs MUST carry the per-op ring check: a missing
+            # report (server died before [stats], finalize timed out) is
+            # an UNVERIFIED run, not a skippable one — same rule as
+            # shadow_verified_all. Shadow-mode segments have no ring and
+            # no flag at all.
+            out["hash_log_ok_all"] = all(v is True for v in hash_logs)
     if trace_events is not None:
         out["trace_events"] = trace_events
     return out
@@ -596,9 +609,13 @@ def bench_e2e(stage, trace: bool = False) -> dict:
       state fingerprints) — the TPU holds real durable state without a
       d2h in the timed path.
     - DUAL backend, two-phase-heavy (pend->post pairs);
-    - device backend, short run (replies THROUGH the TPU — honest about
-      this environment's post-d2h degraded transport, see
-      models/native_ledger.py).
+    - dual-commit durable mode (`--backend dual`, the e2e_device
+      segment): the device applier FOLLOWS the committed op stream off
+      the reply path (h2d only) — durable_device_tps is the
+      through-stack TPU number with the device holding real, verified
+      state (per-op hash-log ring + fingerprints), replacing the old
+      reply-through-the-device configuration that paid a device round
+      trip per commit (15x under native in r05).
 
     MUST run before this process touches JAX: the server subprocesses own
     the TPU chip."""
@@ -639,16 +656,30 @@ def bench_e2e(stage, trace: bool = False) -> dict:
         out["two_phase"] = {"error": f"{type(e).__name__}: {e}"}
         print(f"[e2e two-phase] FAILED: {e}", file=sys.stderr)
     try:
-        with stage("e2e_device"):
-            from tigerbeetle_tpu.benchmark import run_e2e
-
-            dv = run_e2e(
-                n_accounts=N_ACCOUNTS,
-                n_transfers=int(os.environ.get("BENCH_E2E_DEV", 200_000)),
-                clients=16, backend="device", log=log,
-            )
+        # The e2e_device segment now MEASURES dual-commit durable mode
+        # (`--backend dual`): the native engine serves replies on the
+        # critical path while the device applier follows the committed op
+        # stream asynchronously (h2d only) — so durable_device_tps is the
+        # honest through-stack number for a server whose device state is
+        # real, verified state, instead of the reply-through-the-device
+        # configuration that paid a device round trip per commit (47.2k
+        # in r05, 15x under the native path). Parity is proven per run:
+        # state fingerprints + code-stream digests + the per-op hash-log
+        # ring check, all after the clock stops.
+        dv = _median_e2e(
+            stage, "e2e_device", n_runs, log,
+            n_accounts=N_ACCOUNTS,
+            n_transfers=int(os.environ.get("BENCH_E2E_DEV", 1_000_000)),
+            clients=clients, backend="dual", driver=driver,
+        )
         out["device_backend"] = dv
         out["durable_device_tps"] = dv["durable_tps"]
+        out["durable_device_runs"] = dv["durable_runs"]
+        out["durable_device_spread"] = dv["durable_spread"]
+        out["device_shadow_verified_all"] = dv.get("shadow_verified_all")
+        out["device_hash_log_ok"] = dv.get("hash_log_ok_all")
+        out["device_lag_ops"] = dv.get("device_lag_ops")
+        out["device_apply_overlap"] = dv.get("device_apply_overlap")
     except Exception as e:
         out["device_backend"] = {"error": f"{type(e).__name__}: {e}"}
         print(f"[e2e device] FAILED: {e}", file=sys.stderr)
@@ -679,6 +710,25 @@ def bench_e2e(stage, trace: bool = False) -> dict:
     except Exception as e:
         out["cdc"] = {"error": f"{type(e).__name__}: {e}"}
         print(f"[e2e cdc] FAILED: {e}", file=sys.stderr)
+    # Fuse-window regression artifact: the hit rate (and the window the
+    # autotune ended at) PER SEGMENT — r05's single 0.4562 aggregate could
+    # not say which workload/window pairing produced it.
+    segs = {
+        "e2e_durable": out,
+        "e2e_two_phase": out.get("two_phase", {}),
+        "e2e_device": out.get("device_backend", {}),
+        "e2e_cdc": out.get("cdc", {}),
+    }
+    out["group_hit_rate_by_segment"] = {
+        k: {
+            "hit_rate": v.get("group_commit_hit_rate"),
+            "hit_rate_runs": v.get("group_commit_hit_rate_runs"),
+            "fuse_window_us": v.get("fuse_window_us"),
+            "fuse_holds": v.get("group_fuse_holds"),
+            "fuse_expired": v.get("group_fuse_expired"),
+        }
+        for k, v in segs.items()
+    }
     return out
 
 
@@ -833,13 +883,18 @@ def main() -> None:
     # its end. The headline is the median over segments SELECTED by a
     # printed dispatch-health rule: the inter-segment spread tracks the
     # REMOTE launch path's latency, not the kernels (round-5 verdict: a
-    # 0.49 spread whose outlier segment coincided with a degraded probe),
-    # so each segment carries its own pre-segment probe and segments whose
-    # probe exceeds SEG_PROBE_FACTOR x the minimum observed probe are
-    # excluded. SEG_SPARE spare segments run so the selection can still
-    # report SEG_PLAN healthy samples; every segment commits regardless
-    # (conservation counts all groups).
-    SEG_PLAN, SEG_SPARE, SEG_PROBE_FACTOR = 5, 2, 2.0
+    # 0.49 spread whose outlier segment coincided with a degraded probe).
+    # Round-8 tightening (r05 still printed 0.49 vs the <= 0.15 target):
+    # (1) WARMUP DISCIPLINE — a few untimed steady-state groups run
+    # before segment 0 (the compile/latency phases exercised stepper1,
+    # so the first timed segment used to pay sustained-run establishment
+    # inside its clock); (2) each segment is probed BEFORE AND AFTER
+    # (a mid-segment transport degradation lands in the post-probe that
+    # the pre-probe missed); (3) the health factor drops 2.0 -> 1.5.
+    # Every decision input (both probe arrays, the floor, the factor)
+    # rides out in the bench JSON so the artifact shows whether the rule
+    # held, not just its verdict.
+    SEG_PLAN, SEG_SPARE, SEG_PROBE_FACTOR = 5, 2, 1.5
     n_groups = max(0, (n_flag_batches - done) // K_FUSE)
     n_total = SEG_PLAN + SEG_SPARE
     # small-budget runs (BENCH_TRANSFERS shrunk) still get the SEG_PLAN
@@ -851,14 +906,31 @@ def main() -> None:
         n_segs = SEG_PLAN
     else:
         n_segs = 1 if n_groups else 0
-    seg_size = n_groups // n_segs if n_segs else 0
+    warm_groups = 2 if n_groups >= 4 * n_total else 0
+    n_seg_groups = n_groups - warm_groups
+    seg_size = n_seg_groups // n_segs if n_segs else 0
     seg_runs_all: list[float] = []
     seg_probes: list[float] = []
+    seg_probes_after: list[float] = []
     g = 0
     t_all = time.perf_counter()
+    for _ in range(warm_groups):
+        # untimed steady-state establishment (counts toward conservation)
+        ts += K_FUSE * BATCH
+        state, code_max = stepper(
+            state, code_max, jax.random.fold_in(key, 10_000 + g),
+            jnp.uint64(next_id), jnp.uint64(ts),
+        )
+        next_id += K_FUSE * BATCH
+        g += 1
+    if warm_groups:
+        jax.block_until_ready(code_max)
     for seg in range(n_segs):
         seg_probes.append(round(probe_dispatch(20), 1))
-        take = seg_size if seg < n_segs - 1 else n_groups - seg_size * (n_segs - 1)
+        take = (
+            seg_size if seg < n_segs - 1
+            else n_seg_groups - seg_size * (n_segs - 1)
+        )
         t0 = time.perf_counter()
         for _ in range(take):
             ts += K_FUSE * BATCH
@@ -870,6 +942,7 @@ def main() -> None:
             g += 1
         jax.block_until_ready(code_max)
         dt = time.perf_counter() - t0
+        seg_probes_after.append(round(probe_dispatch(20), 1))
         if take:
             seg_runs_all.append(take * K_FUSE * BATCH / dt)
     stages["flagship"] = time.perf_counter() - t_all
@@ -877,23 +950,32 @@ def main() -> None:
     n_timed = n_groups * K_FUSE * BATCH
     # -- segment selection (the printed rule) --
     seg_rule = (
-        f"keep segments whose pre-segment dispatch probe <= "
-        f"{SEG_PROBE_FACTOR}x min(probe); first {SEG_PLAN} healthy count"
+        f"keep segments whose pre- AND post-segment dispatch probes <= "
+        f"{SEG_PROBE_FACTOR}x min(all probes); first {SEG_PLAN} healthy "
+        f"count ({warm_groups} untimed warm groups precede segment 0)"
     )
     if seg_runs_all:
-        floor = min(seg_probes)
-        # the minimum probe satisfies its own bound, so `healthy` (and
-        # therefore `selected`) is never empty when any segment ran
+        floor = min(min(seg_probes), min(seg_probes_after))
         healthy = [
-            i for i, p in enumerate(seg_probes)
-            if p <= SEG_PROBE_FACTOR * floor
+            i for i in range(len(seg_runs_all))
+            if seg_probes[i] <= SEG_PROBE_FACTOR * floor
+            and seg_probes_after[i] <= SEG_PROBE_FACTOR * floor
         ]
+        if not healthy:
+            # a uniformly degraded run still needs a headline: fall back
+            # to the least-degraded segment rather than reporting nothing
+            # (the JSON carries the probes, so the fallback is visible)
+            healthy = [
+                int(np.argmin(np.maximum(seg_probes, seg_probes_after)))
+            ]
         selected = healthy[:SEG_PLAN]
     else:
+        floor = None
         selected = []
     seg_runs = [seg_runs_all[i] for i in selected]
     print(
         f"flagship segment rule: {seg_rule}; probes_us={seg_probes} "
+        f"probes_after_us={seg_probes_after} floor={floor} "
         f"selected={selected} "
         f"discarded={[i for i in range(len(seg_runs_all)) if i not in selected]}",
         file=sys.stderr,
@@ -1040,10 +1122,16 @@ def main() -> None:
                 "flagship_runs": [round(x, 1) for x in seg_runs],
                 "flagship_spread": flagship_spread,
                 # the selection rule is part of the artifact: the headline
-                # is reproducible only with the rule that produced it
+                # is reproducible only with the rule that produced it —
+                # and EVERY decision input rides along (both probe
+                # arrays, the floor, the factor), so the next driver
+                # artifact shows whether the rule held
                 "flagship_rule": seg_rule,
                 "flagship_runs_all": [round(x, 1) for x in seg_runs_all],
                 "flagship_probe_us": seg_probes,
+                "flagship_probe_after_us": seg_probes_after,
+                "flagship_probe_floor_us": floor,
+                "flagship_probe_factor": SEG_PROBE_FACTOR,
                 "flagship_selected": selected,
                 "dispatch_us_per_launch": [
                     dispatch_us_before, dispatch_us_after
@@ -1056,7 +1144,19 @@ def main() -> None:
                 "durable_spread": e2e.get("durable_spread"),
                 "durable_two_phase_tps": e2e.get("durable_two_phase_tps", 0.0),
                 "durable_shadow_verified_all": e2e.get("shadow_verified_all"),
+                # dual-commit durable mode (`--backend dual`): the device
+                # follows the committed stream asynchronously, so the
+                # through-stack device number rides the native reply path
+                # — parity (fingerprints + digests + per-op hash-log
+                # ring) verified per run, after the clock stops
                 "durable_device_tps": e2e.get("durable_device_tps", 0.0),
+                "durable_device_spread": e2e.get("durable_device_spread"),
+                "device_shadow_verified_all": e2e.get(
+                    "device_shadow_verified_all"
+                ),
+                "device_hash_log_ok": e2e.get("device_hash_log_ok"),
+                "device_lag_ops": e2e.get("device_lag_ops"),
+                "device_apply_overlap": e2e.get("device_apply_overlap"),
                 # CDC A/B: live change stream into a deliberately slow
                 # sink — throughput must hold vs durable_tps while the
                 # pump (not the replica) absorbs the backpressure
@@ -1065,6 +1165,13 @@ def main() -> None:
                 "cdc_backpressure_pauses": e2e.get("cdc_backpressure_pauses"),
                 "group_commit_hit_rate": e2e.get("group_commit_hit_rate", 0.0),
                 "group_fuse_width": e2e.get("group_fuse_width"),
+                # per-segment fuse diagnostics (hit rate, holds/expired,
+                # the window autotune ended at) — the 0.4562-vs-0.85
+                # regression's attribution artifact
+                "group_hit_rate_by_segment": e2e.get(
+                    "group_hit_rate_by_segment"
+                ),
+                "fuse_window_us": e2e.get("fuse_window_us"),
                 "shadow_upload_overlap": e2e.get("shadow_upload_overlap"),
                 "loop_us_per_batch": e2e.get("loop_us_per_batch"),
                 "spill_active_tps": configs.get("spill_active_tps", 0.0),

@@ -41,6 +41,7 @@ def run_seed(seed: int, ticks: int, device_fraction: float,
              cdc_fraction: float = CDC_FRACTION_DEFAULT,
              ingress_fraction: float = INGRESS_FRACTION_DEFAULT,
              trace_path: str | None = None,
+             hash_log: tuple[str, str] | None = None,
              ) -> tuple[dict | None, str, str | None]:
     """(stats, topology-line, error) for one seed. A `verify_fraction`
     slice of seeds runs with the intensive online-verification tier
@@ -77,6 +78,13 @@ def run_seed(seed: int, ticks: int, device_fraction: float,
             opts["storm_clients"] = 4 + seed % 8
             opts["cdc_fanout"] = 3
     kw = {"ticks": ticks, **opts}
+    if hash_log is not None:
+        # record-then-check divergence debugging (testing/hash_log.py;
+        # the reference's -Dhash-log-mode): first run of a seed records
+        # its committed prepare/reply checksum stream, a replay checks
+        # and dies AT the first divergent op
+        kw["hash_log"] = hash_log
+        desc += f" HASHLOG[{hash_log[0]}]"
     if trace_path is not None:
         # deterministic tick-stamped trace (tracer.SimTracer): the same
         # seed dumps byte-identical files, so two replays of a diverging
@@ -124,12 +132,27 @@ def main() -> int:
                     help="dump a deterministic tick-stamped Chrome trace "
                          "per seed to PATH.<seed>.json (byte-identical "
                          "across replays of the same seed — diffable)")
+    ap.add_argument("--hash-log", default=None, metavar="PREFIX",
+                    help="per-seed hash-log at PREFIX.<seed>.jsonl: a "
+                         "seed with no recording RECORDS its committed "
+                         "prepare/reply checksum stream; a seed whose "
+                         "recording exists CHECKS against it and fails "
+                         "at the first divergent op (dual-mode parity "
+                         "debugging outside the bench harness)")
     args = ap.parse_args()
 
     failures = []
     sink = open(args.json, "a") if args.json else None
     t0 = time.time()
     for seed in range(args.start, args.start + args.seeds):
+        hash_log = None
+        if args.hash_log:
+            import os
+
+            hl_path = f"{args.hash_log}.{seed}.jsonl"
+            hash_log = (
+                "check" if os.path.exists(hl_path) else "record", hl_path
+            )
         stats, desc, err = run_seed(
             seed, args.ticks, args.device_fraction, args.fixed,
             verify_fraction=args.verify_fraction,
@@ -138,6 +161,7 @@ def main() -> int:
             trace_path=(
                 f"{args.trace}.{seed}.json" if args.trace else None
             ),
+            hash_log=hash_log,
         )
         if err is None:
             print(

@@ -52,6 +52,47 @@ def test_injected_divergence_caught_at_first_op(tmp_path):
     assert e.value.kind == "prepare"  # body changed -> log diverges
 
 
+def test_parse_hash_log_spec():
+    from tigerbeetle_tpu.testing.hash_log import parse_hash_log_spec
+
+    assert parse_hash_log_spec("record:/tmp/x.jsonl") == (
+        "record", "/tmp/x.jsonl"
+    )
+    assert parse_hash_log_spec("check:/tmp/x.jsonl") == (
+        "check", "/tmp/x.jsonl"
+    )
+    # bare path records; a path with a colon elsewhere stays intact
+    assert parse_hash_log_spec("/tmp/x.jsonl") == ("record", "/tmp/x.jsonl")
+
+
+def test_simulator_hash_log_record_then_check(tmp_path):
+    """The vopr/simulator surface (satellite wiring): a seed RECORDS its
+    committed prepare/reply checksum stream; the same seed CHECKS clean;
+    a tampered recording fails the replay at its exact op — hash-log
+    debugging outside the bench harness."""
+    import json
+
+    from tigerbeetle_tpu.testing.simulator import run_simulation
+
+    path = str(tmp_path / "seed9.jsonl")
+    stats = run_simulation(9, ticks=250, hash_log=("record", path))
+    assert stats["hash_log_mode"] == "record"
+    assert stats["hash_log_ops"] >= 1
+    # same seed, check mode: replays hash-for-hash
+    stats2 = run_simulation(9, ticks=250, hash_log=("check", path))
+    assert stats2["hash_log_ops"] == stats["hash_log_ops"]
+    # tamper one recorded prepare hash -> the replay dies AT that op
+    lines = [json.loads(x) for x in open(path)]
+    victim = lines[len(lines) // 2]
+    victim["prepare"] = hex(int(victim["prepare"], 16) ^ 1)
+    with open(path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    with pytest.raises(HashLogDivergence) as e:
+        run_simulation(9, ticks=250, hash_log=("check", path))
+    assert e.value.op == int(victim["op"])
+
+
 def test_reply_stream_catches_execution_divergence(tmp_path):
     """Same LOG, different results: simulate a kernel nondeterminism by
     checking a recording whose reply hash was corrupted — the prepare

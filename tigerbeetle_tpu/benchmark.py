@@ -255,10 +255,11 @@ def run_e2e(
         # shutdown — off the clock, but the wait must cover it.
         proc.terminate()
         try:
-            # dual mode: must outlast DualLedger.finalize's own drain
+            # dual modes: must outlast DualLedger.finalize's own drain
             # timeout (600s) or a slow-but-legal verification is killed
             # mid-flight and the [stats] line is lost
-            proc.wait(timeout=650 if "+" in backend else 10)
+            dual = "+" in backend or backend == "dual"
+            proc.wait(timeout=650 if dual else 10)
         except subprocess.TimeoutExpired:
             pass
         drain_thread.join(timeout=5)
@@ -274,6 +275,15 @@ def run_e2e(
                     result["group_fuse_width"] = round(
                         g["fused_ops"] / g["fused_groups"], 2
                     )
+                # fuse-window diagnostics: holds that expired short vs
+                # holds at all, and the window the run ended at (autotune
+                # moves it) — a low hit rate is attributable, not a mystery
+                result["group_fuse_holds"] = g.get("fuse_holds", 0)
+                result["group_fuse_expired"] = g.get("fuse_expired", 0)
+            fuse = server_stats.get("fuse", {})
+            if fuse:
+                result["fuse_window_us"] = fuse.get("window_us")
+                result["fuse_autotune"] = fuse.get("autotune")
             loop = server_stats.get("loop", {})
             if loop:
                 result["loop_us_per_batch"] = loop.get("us_per_batch")
@@ -298,6 +308,20 @@ def run_e2e(
                 sh = server_stats["device_shadow"].get("shadow") or {}
                 if sh.get("upload_overlap") is not None:
                     result["shadow_upload_overlap"] = sh["upload_overlap"]
+                # dual (follower) mode: the end-of-run hash-log ring
+                # check + the applier's lag/overlap gauges
+                hl = server_stats["device_shadow"].get("hash_log")
+                if hl is not None:
+                    result["device_hash_log_ok"] = hl.get("ok")
+                gauges = server_stats.get("metrics", {}).get("gauges", {})
+                if "shadow.device_lag_ops" in gauges:
+                    result["device_lag_ops"] = gauges[
+                        "shadow.device_lag_ops"
+                    ]
+                if "shadow.device_apply_overlap" in gauges:
+                    result["device_apply_overlap"] = gauges[
+                        "shadow.device_apply_overlap"
+                    ]
         if server_trace and os.path.exists(server_trace):
             import json as _json
 

@@ -77,17 +77,38 @@ class StartArgs:
     # quorum-ready run of create_transfers holds this long — only while
     # earlier commits are in flight — so near-simultaneous arrivals
     # coalesce into one fused dispatch (vsr/replica.py fuse_window_ns).
-    fuse_window_us: int = 2000
+    # -1 (the default) AUTOTUNES: AIMD from observed hold outcomes —
+    # expired-short holds widen the window, holds that fill to GROUP_MAX
+    # shrink it (bounded 500us..8ms; starts at 2000us). The r05 driver's
+    # 0.46 hit rate against the CPU A/B's 0.85 motivated making the
+    # window track the workload instead of trusting one constant.
+    fuse_window_us: int = -1
     # Commit backend: "native" = the C++ host engine (native/ledger.cc —
     # the durable hot path; this environment's tunneled TPU degrades
     # permanently on any device->host fetch, see models/native_ledger.py),
-    # "native+device" = the DUAL mode: native serves replies while the
-    # device shadows every prepare (h2d only) and shutdown verifies the
-    # device state bit-exact (models/dual_ledger.py),
+    # "native+device" = the SHADOW dual mode: native serves replies while
+    # the device mirrors every prepare (h2d only) and shutdown verifies
+    # the device state bit-exact (models/dual_ledger.py),
+    # "dual" = the dual-commit FOLLOWER plan: like native+device, but the
+    # REPLICA enqueues committed ops to the device applier at commit
+    # finalize — rolling per-op hash-log rings (first divergent op named
+    # exactly), bounded-lag admission backpressure, checkpoint/state-sync
+    # drains, and restart recovery via snapshot row install,
     # "device" = the JAX DeviceLedger (the TPU compute path; supports
     # HBM->LSM spill), "sharded" = the multi-chip ShardedLedger over a
     # jax.sharding.Mesh (parallel/mesh.py; slots flags are PER SHARD).
     backend: str = "native"
+    # Dual-commit follower: device-applier lag (committed ops not yet
+    # dispatched to the device) beyond this window throttles admission
+    # (Replica.ingress_occupancy / the _on_request cap) instead of
+    # growing without bound.
+    device_lag_window: int = 128
+    # hash_log surface (testing/hash_log.py; reference -Dhash-log-mode,
+    # src/testing/hash_log.zig): "record:<path>" streams one prepare/reply
+    # checksum pair per committed op to <path> at shutdown; "check:<path>"
+    # replays against a recording and fails AT the first divergent op.
+    # A bare "<path>" records.
+    hash_log: str = ""
     shards: int = 0  # sharded backend: devices in the mesh (0 = all)
     # Session capacity — MUST match the values the data file was
     # formatted with (config fingerprint; see FormatArgs).
@@ -280,14 +301,18 @@ def cmd_start(args) -> int:
         backend_factory = lambda: NativeLedger(  # noqa: E731
             args.account_slots_log2, args.transfer_slots_log2
         )
-    elif args.backend == "native+device":
+    elif args.backend in ("native+device", "dual"):
         from tigerbeetle_tpu.models.dual_ledger import DualLedger
 
         backend_factory = lambda: DualLedger(  # noqa: E731
             args.account_slots_log2, args.transfer_slots_log2,
             # compiles happen at boot, before "listening" — an in-window
-            # compile stalls the shadow queue into the reply path
+            # compile stalls the apply queue into the reply path
             warm_kernels=True,
+            # "dual" = the follower plan: the replica enqueues committed
+            # ops at finalize, with hash-log rings + lag backpressure
+            follower=args.backend == "dual",
+            lag_window=args.device_lag_window,
         )
     elif args.backend == "sharded":
         import jax
@@ -312,7 +337,7 @@ def cmd_start(args) -> int:
     elif args.backend != "device":
         flags.fatal(
             f"unknown --backend {args.backend!r} "
-            "(native|native+device|device|sharded)"
+            "(native|native+device|dual|device|sharded)"
         )
     replica = Replica(
         args.replica, len(addresses), storage, bus, RealTime(),
@@ -327,7 +352,23 @@ def cmd_start(args) -> int:
     if args.aof:
         replica.aof = AOF(args.aof)
     replica.commit_window = args.commit_window
-    replica.fuse_window_ns = args.fuse_window_us * 1000
+    if args.fuse_window_us < 0:
+        # autotune (the default): start at the old 2ms constant, adapt
+        # from hold outcomes (vsr/replica.py _fuse_hold AIMD)
+        replica.fuse_autotune = True
+        replica.fuse_window_ns = 2_000_000
+    else:
+        replica.fuse_window_ns = args.fuse_window_us * 1000
+    hash_log = None
+    if args.hash_log:
+        from tigerbeetle_tpu.testing.hash_log import HashLog, parse_hash_log_spec
+
+        mode, hl_path = parse_hash_log_spec(args.hash_log)
+        hash_log = HashLog(mode, path=hl_path)
+        # attach BEFORE open(): single-replica recovery re-commits the
+        # journal tail — record mode re-records identical entries, check
+        # mode re-verifies them (both idempotent by op)
+        hash_log.attach(replica)
     cdc_pump = None
     if args.cdc_jsonl or args.cdc_udp:
         from tigerbeetle_tpu.cdc import (
@@ -434,6 +475,13 @@ def cmd_start(args) -> int:
         hz = getattr(replica.ledger, "hazards", None)
         stats = {
             "group": dict(replica.group_stats),
+            # the fuse window the run ENDED at (autotune moves it): the
+            # bench records this per segment next to the hit rate, so a
+            # bad hit rate is attributable to the window it ran with
+            "fuse": {
+                "window_us": replica.fuse_window_ns // 1000,
+                "autotune": replica.fuse_autotune,
+            },
             "split": dict(hz.split_stats) if hz is not None else {},
             "pool_dropped": bus.pool.dropped,
             "loop": {
@@ -451,6 +499,21 @@ def cmd_start(args) -> int:
         }
         if getattr(replica.ledger, "spill", None) is not None:
             stats["spill"] = dict(replica.ledger.spill.stats)
+        if hash_log is not None:
+            # record mode persists the stream; both modes report coverage
+            # (check mode would already have died AT a divergent op)
+            try:
+                if hash_log.mode == "record":
+                    hash_log.save()
+                stats["hash_log"] = {
+                    "mode": hash_log.mode,
+                    "path": hash_log.path,
+                    # coverage THIS RUN (check mode preloads `entries`
+                    # from the recording — its length is not coverage)
+                    "ops": hash_log.ops_seen,
+                }
+            except Exception as e:
+                stats["hash_log"] = {"error": f"{type(e).__name__}: {e}"}
         if hasattr(replica.ledger, "finalize"):
             # dual mode: drain the device shadow, then the process's FIRST
             # d2h reads verify the device state bit-exact (after the

@@ -350,6 +350,8 @@ CATALOG = {
     "commit.group.fused_ops": ("counter", "ops", "ops committed via a fused group dispatch"),
     "commit.group.solo_ops": ("counter", "ops", "ops committed via the per-op fallback"),
     "commit.group.fused_groups": ("counter", "groups", "fused group dispatches"),
+    "commit.group.fuse_holds": ("counter", "", "fuse-window holds opened on a short run"),
+    "commit.group.fuse_expired": ("counter", "", "holds expired with the run still short"),
     "replica.quorum_wait_us": ("histogram", "us", "prepare broadcast -> replication quorum"),
     "replica.fuse_hold_us": ("histogram", "us", "group-commit fuse-window hold duration"),
     "replica.commit_dispatch_us": ("histogram", "us", "host time staging+launching one commit"),
@@ -396,6 +398,10 @@ CATALOG = {
     "shadow.stage_s": ("counter", "s", "host seconds staging+dispatching shadow work"),
     "shadow.idle_s": ("counter", "s", "shadow loop seconds blocked on an empty queue"),
     "shadow.overlapped": ("counter", "", "groups staged while the previous kernel ran"),
+    # dual-commit follower mode (`--backend dual`)
+    "shadow.device_lag_ops": ("gauge", "ops", "committed ops not yet device-dispatched"),
+    "shadow.device_apply_overlap": ("gauge", "", "fused applies staged while the prior kernel ran"),
+    "shadow.drain_timeouts": ("counter", "", "applier drains that timed out (parity at risk)"),
     # device ledger
     "ledger.staging_wait_us": ("histogram", "us", "group staging double-buffer fence waits"),
     # change-data-capture (tigerbeetle_tpu/cdc/pump.py)

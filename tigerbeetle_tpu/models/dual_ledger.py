@@ -1,29 +1,48 @@
-"""DualLedger: native C++ engine serves replies, the TPU shadows every
-prepare — the `--backend native+device` durable mode.
+"""DualLedger: native C++ engine serves replies, the TPU applies the same
+prepares asynchronously — the dual-commit durable modes.
 
 The problem this solves (round-4 verdict): on this environment's tunneled
 TPU, ANY device->host fetch permanently degrades the dispatch path
 (models/native_ledger.py), so a reply-serving server cannot run its hot
 loop through the device — but that blocks *reply-from-device*, not
 *commit-on-device*. Here the native engine (native/ledger.cc) computes
-reply codes at host speed, while a background shadow thread applies the
+reply codes at host speed, while a background device thread applies the
 SAME prepares, same timestamps, same order, to the JAX DeviceLedger —
 host->device uploads and kernel launches only, nothing ever read back
 until shutdown. Device state is REAL state: maintained batch-by-batch by
 the same commit kernels the flagship benchmark measures.
 
+Two modes:
+
+- **shadow** (``--backend native+device``): the ledger auto-enqueues every
+  create batch at execute time; the device is a passive mirror verified at
+  shutdown. No op numbers, no replica integration.
+- **follower** (``--backend dual``): the REPLICA drives the apply queue —
+  each committed op is enqueued at commit FINALIZE (reply built, WAL
+  durable) via apply_commit(op, ...), so the device follows the committed
+  op stream with an explicit watermark. This buys: a rolling per-op
+  hash-log ring on BOTH sides (first divergent op is named exactly, not
+  just "the digests differ"), bounded-lag admission backpressure
+  (`apply_lag_excess` feeds Replica.ingress_occupancy and the PR-6
+  credit regulator), checkpoint/state-sync drains, and restart recovery —
+  restore_bytes re-seeds the device from the native snapshot's row images
+  through DeviceLedger.install_snapshot_rows (h2d only).
+
 Verification (hash_log semantics, testing/hash_log.py):
 - every batch's dense reply codes are folded into a chained u64 digest on
   BOTH sides — on device (fold_reply_codes, no d2h) and on host over the
-  native engine's codes (fold_reply_codes_np, chained off the engine
-  worker's completion callbacks, same FIFO order);
-- at shutdown, finalize() drains the shadow queue and does the process's
-  FIRST device->host reads: the two fold scalars must match (the full
-  reply-code stream was bit-identical), and state_fingerprint — an
-  order-independent digest over every live account/transfer row's 128-byte
-  wire image, implemented identically in C++ (tb_ledger_fingerprint) and
-  JAX (models/ledger.py state_fingerprint) — must match row-set for
-  row-set.
+  native engine's codes (same stream order);
+- in follower mode each op's post-fold chain value is also written into a
+  rolling ring (host-side numpy ring + device-side ring updated inside the
+  fold kernel), so the end-of-run check can walk the rings and fail AT the
+  first divergent op — the reference's -Dhash-log-mode check applied
+  across heterogeneous engines (src/testing/hash_log.zig);
+- at shutdown, finalize() drains the apply queue and does the process's
+  FIRST device->host reads: the fold scalars must match, the rings must
+  match entry for entry, and state_fingerprint — an order-independent
+  digest over every live account/transfer row's 128-byte wire image,
+  implemented identically in C++ (tb_ledger_fingerprint) and JAX
+  (models/ledger.py state_fingerprint) — must match row-set for row-set.
 
 Reference seam: src/state_machine.zig:508-540 — commit determinism is the
 consensus invariant; the dual mode extends it across heterogeneous engines
@@ -41,12 +60,20 @@ import numpy as np
 from tigerbeetle_tpu.constants import ConfigProcess
 from tigerbeetle_tpu.metrics import Metrics
 from tigerbeetle_tpu.models.native_ledger import NativeLedger
+from tigerbeetle_tpu.testing.hash_log import HashLogDivergence
 from tigerbeetle_tpu.tracer import NULL_TRACER
 from tigerbeetle_tpu.types import Operation
 
 _STOP = object()
+_INSTALL = "__install__"  # control item: re-seed the device from a snapshot
+
+# Rolling per-op digest ring (follower mode): one chained-fold value per
+# committed create op, op % RING. 4096 ops cover well over a full WAL ring
+# of divergence localization without unbounded memory on either side.
+APPLY_RING = 1 << 12
 
 _FOLD_GROUP_CACHE: dict = {}
+_FOLD_RING_CACHE: dict = {}
 
 
 def _fold_group_fn(k: int, n_pad: int):
@@ -79,9 +106,74 @@ def _fold_group_fn(k: int, n_pad: int):
     return fn
 
 
+def _fold_group_ring_fn(k: int, n_pad: int):
+    """Follower variant of _fold_group_fn: the scan also EMITS each
+    batch's post-fold chain value, and the per-op values are scattered
+    into the rolling device ring at their ops' slots. The ring carries a
+    DUMP slot at index APPLY_RING and inactive lanes are routed there by
+    the caller — scattering a stale read-back at a real slot instead
+    would race an active lane that maps to the same slot (duplicate-index
+    .at[].set is order-undefined) and fabricate a divergence. Chain-
+    identical to _fold_group_fn — the ring write rides the same dispatch,
+    so the apply loop stays one launch per fused group with no d2h."""
+    fn = _FOLD_RING_CACHE.get(("group", k, n_pad))
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        from tigerbeetle_tpu.models.ledger import fold_reply_codes
+
+        def f(chk, ring, idxs, flat, ns, active):
+            flat2 = flat[: k * n_pad].reshape(k, n_pad)
+
+            def body(c, x):
+                res, n, a = x
+                c2 = jnp.where(a, fold_reply_codes(c, res, n), c)
+                return c2, c2
+
+            c2, chain = jax.lax.scan(body, chk, (flat2, ns, active))
+            return c2, ring.at[idxs].set(chain)
+
+        fn = _FOLD_RING_CACHE[("group", k, n_pad)] = jax.jit(
+            f, donate_argnums=(1,)
+        )
+    return fn
+
+
+def _fold_ring_fn():
+    """Solo-batch follower fold: chain + one ring write, one dispatch."""
+    fn = _FOLD_RING_CACHE.get("solo")
+    if fn is None:
+        import jax
+
+        from tigerbeetle_tpu.models.ledger import fold_reply_codes
+
+        def f(chk, ring, idx, results, n):
+            c2 = fold_reply_codes(chk, results, n)
+            return c2, ring.at[idx].set(c2)
+
+        fn = _FOLD_RING_CACHE["solo"] = jax.jit(f, donate_argnums=(1,))
+    return fn
+
+
+def raise_on_parity_divergence(report: dict) -> None:
+    """Hash-log check mode over a finalize() report: a failed run raises
+    HashLogDivergence AT the first divergent op when the rings localized
+    one (testing/hash_log.py semantics), else a plain AssertionError."""
+    if report.get("verified") is not False:
+        return
+    hl = report.get("hash_log") or {}
+    op = hl.get("first_divergent_op")
+    if op is not None:
+        raise HashLogDivergence(
+            op, "device-apply", hl.get("want", 0), hl.get("got", 0)
+        )
+    raise AssertionError(f"dual-commit parity failed: {report}")
+
+
 class DualLedger:
     """Replica backend: NativeLedger semantics + an asynchronous device
-    shadow. All reply-serving calls delegate to the native engine; the
+    apply loop. All reply-serving calls delegate to the native engine; the
     device never blocks (or touches) the reply path."""
 
     zero_copy_events = True  # both consumers only read the event rows
@@ -92,24 +184,34 @@ class DualLedger:
 
     def instrument(self, metrics, tracer) -> None:
         """Re-bind onto a shared registry/tracer (the replica's).
-        Accumulated values carry over; the shadow loop reads
-        self.shadow_stats/self.tracer per use. A shadow update racing
+        Accumulated values carry over; the apply loop reads
+        self.shadow_stats/self.tracer per use. A loop update racing
         the carry-over/rebind window lands in the discarded old group
         and is DROPPED from the new registry — at most one update, and
         instrument() runs at setup before commits flow, so nothing of
         record is lost."""
         for key in self.SHADOW_KEYS:
             metrics.counter(f"shadow.{key}").add(self.shadow_stats[key])
-        self.metrics = metrics
-        # rebound on the event loop while the shadow thread reads per
+        # rebound on the event loop while the apply thread reads per
         # use — a GIL-atomic reference swap, never a torn value; see the
         # docstring for the (setup-time-only) dropped-update window
+        self.metrics = metrics  # vet: handoff
         self.tracer = tracer  # vet: handoff
         # registry-backed StatGroup; Counter.add serializes internally
         self.shadow_stats = metrics.group(  # vet: handoff
             "shadow", self.SHADOW_KEYS
         )
-        # the shadow DeviceLedger's own instrumentation (group staging
+        if self.follower:
+            # gauges bound ONCE (a registry lookup per committed op would
+            # tax the hot paths the counters observe — the PR-6 bus
+            # lesson); the APPLY thread is the only writer
+            self._lag_gauge = metrics.gauge(  # vet: handoff
+                "shadow.device_lag_ops"
+            )
+            self._overlap_gauge = metrics.gauge(  # vet: handoff
+                "shadow.device_apply_overlap"
+            )
+        # the device ledger's own instrumentation (group staging
         # fence waits) reports into the same store
         self.device.instrument(metrics, tracer)
 
@@ -119,8 +221,19 @@ class DualLedger:
         xfer_slots_log2: int = 20,
         queue_max: int = 256,
         warm_kernels: bool = False,
+        follower: bool = False,
+        lag_window: int = 128,
     ):
         self.native = NativeLedger(acct_slots_log2, xfer_slots_log2)
+        # follower (the `dual` backend plan): the replica enqueues ops at
+        # commit finalize via apply_commit; execute paths do NOT
+        # auto-enqueue. Replica detects the plan via this attribute.
+        self.follower = self.dual_follower = follower
+        # Bounded-lag admission window (ops): apply lag beyond it feeds
+        # Replica.ingress_occupancy, so the PR-6 credit regulator (and
+        # the bare _on_request cap) throttles ADMISSION instead of the
+        # bounded queue's put() eventually stalling the event loop.
+        self.lag_window = lag_window
         from tigerbeetle_tpu.models.ledger import DeviceLedger
 
         process = ConfigProcess(
@@ -128,13 +241,14 @@ class DualLedger:
             transfer_slots_log2=xfer_slots_log2,
         )
         # Warm the device kernels BEFORE serving (the server path sets
-        # warm_kernels): an in-window compile would stall the shadow until
-        # the bounded queue fills and then block the reply path (measured:
-        # a 2M-transfer run collapsed from ~960k to ~108k TPS exactly this
-        # way). Warming runs BEFORE the real ledger is allocated so the
-        # scratch tables never double device memory; with the persistent
-        # compilation cache (package __init__) only the first-ever server
-        # pays real compiles here — later boots load from disk in seconds.
+        # warm_kernels): an in-window compile would stall the apply loop
+        # until the bounded queue fills and then block the reply path
+        # (measured: a 2M-transfer run collapsed from ~960k to ~108k TPS
+        # exactly this way). Warming runs BEFORE the real ledger is
+        # allocated so the scratch tables never double device memory; with
+        # the persistent compilation cache (package __init__) only the
+        # first-ever server pays real compiles here — later boots load
+        # from disk in seconds.
         if warm_kernels:
             self._warm_device_kernels(process)
         self.device = DeviceLedger(process=process, mode="auto")
@@ -143,16 +257,45 @@ class DualLedger:
         self.spill = None
         self.hazards = self.device.hazards  # [stats] observability
         # chained digests of the dense reply-code stream (hash_log pair);
-        # folded on the native engine's done-callbacks, read at finalize
+        # shadow mode folds on the native engine's done-callbacks, read at
+        # finalize (follower mode folds on the apply thread instead)
         self._chk_native = 0  # vet: guarded-by=_chk_lock
         self._chk_lock = threading.Lock()
-        # written only by the shadow thread; finalize() joins the thread
+        # written only by the apply thread; finalize() joins the thread
         # before reading either (join-before-read)
         self._shadow_error: Exception | None = None  # vet: handoff
         self._shadow_batches = 0  # vet: handoff
-        # shadow-loop cost accounting (the h2d/staging tax shares the core
+        # follower watermarks: _enqueued_op/_enq_ops written by the event
+        # loop at apply_commit, read by the apply thread for the lag
+        # gauge; _applied_op/_done_ops/_consumed_seq written by the apply
+        # thread, read by the event loop (lag/backpressure/drain). All
+        # GIL-atomic int flips whose one-iteration staleness only skews a
+        # gauge reading. Lag counts ITEMS (one item == one committed
+        # create op), not op-number distance — committed non-create ops
+        # (lookups, registers) and the op-number jump after a restart
+        # never enter the queue and must not read as phantom lag.
+        self._enqueued_op = 0  # vet: handoff
+        self._applied_op = 0  # vet: handoff
+        self._enq_ops = 0  # vet: handoff
+        self._done_ops = 0  # vet: handoff
+        self._put_seq = 0  # event-loop-only (apply_commit/restore_bytes)
+        self._consumed_seq = 0  # vet: handoff
+        self._apply_cond = threading.Condition()
+        # follower hash-log rings (APPLY_RING entries): the host ring
+        # holds (op, prepare_checksum, native chain value) per applied
+        # op; the device ring is its on-device twin, fetched ONCE at
+        # finalize. Written only by the apply thread; finalize joins
+        # before reading (join-before-read).
+        self._op_ring: list = [None] * APPLY_RING  # vet: handoff
+        self._dev_ring_out = None  # vet: handoff
+        self._chk_native_thread = 0  # vet: handoff
+        # test hooks (seeded fault injection for the hash-log check-mode
+        # tests): set before traffic flows, read by the apply thread
+        self._test_corrupt_apply_op: int | None = None  # vet: handoff
+        self._test_apply_delay_s = 0.0  # vet: handoff
+        # loop cost accounting (the h2d/staging tax shares the core
         # with the reply-serving event loop): stage_s = host time spent
-        # staging + dispatching shadow work; idle_s = blocked on an empty
+        # staging + dispatching apply work; idle_s = blocked on an empty
         # queue; overlapped = groups whose staging/dispatch completed
         # while the PREVIOUS group's kernel was still executing (the
         # double-buffer pipeline working as intended). BENCH reports
@@ -163,27 +306,37 @@ class DualLedger:
         self.metrics = Metrics()
         self.tracer = NULL_TRACER
         self.shadow_stats = self.metrics.group("shadow", self.SHADOW_KEYS)
-        # device cannot follow a snapshot restore. Set on the event loop,
-        # polled by the shadow loop: a GIL-atomic bool flip whose one-
-        # iteration staleness only delays the stand-down by a batch
+        if follower:
+            self._lag_gauge = self.metrics.gauge("shadow.device_lag_ops")
+            self._overlap_gauge = self.metrics.gauge(
+                "shadow.device_apply_overlap"
+            )
+        # device cannot follow a snapshot restore without an install path
+        # (shadow mode, or a follower whose snapshot exceeds the device
+        # geometry). Set on the event loop, polled by the apply loop: a
+        # GIL-atomic bool flip whose one-iteration staleness only delays
+        # the stand-down by a batch
         self._restored = False  # vet: handoff
         # the queue IS the cross-thread handoff (bounded, blocking put)
         self._q: queue.Queue = queue.Queue(maxsize=queue_max)  # vet: handoff
         self._thread = threading.Thread(
-            target=self._shadow_loop, name="device-shadow", daemon=True
+            target=self._apply_loop,
+            name="device-applier" if follower else "device-shadow",
+            daemon=True,
         )
         self._thread.start()
 
     def _warm_device_kernels(self, process: ConfigProcess) -> None:
-        """Compile the kernel set the shadow will hit, against a SCRATCH
-        ledger of the same geometry (kernels are shared per ConfigProcess
-        — models.ledger.get_kernels — so the real ledger reuses every
-        compile; scratch state is freed before the real tables allocate).
-        Covers: accounts commit, transfers fast tier, fast_pv (posts),
-        group steppers (both fused capacities), the results summarizer,
-        and the fold kernels, all at the wire batch pad. Rare tiers
-        (serial residue at odd pads) compile on demand — the 256-slot
-        queue absorbs those stalls."""
+        """Compile the kernel set the apply loop will hit, against a
+        SCRATCH ledger of the same geometry (kernels are shared per
+        ConfigProcess — models.ledger.get_kernels — so the real ledger
+        reuses every compile; scratch state is freed before the real
+        tables allocate). Covers: accounts commit, transfers fast tier,
+        fast_pv (posts), group steppers (both fused capacities), the
+        results summarizer, and the fold kernels (ring variants too in
+        follower mode), all at the wire batch pad. Rare tiers (serial
+        residue at odd pads) compile on demand — the 256-slot queue
+        absorbs those stalls."""
         import jax
         import jax.numpy as jnp
 
@@ -248,7 +401,9 @@ class DualLedger:
         ts += n
         scratch.execute_async(Operation.create_transfers, ts, post)
         # both fused group capacities (the replica's group commit) + the
-        # shadow's fused group-fold kernel over each
+        # fused group-fold kernel over each (ring variant in follower
+        # mode — the production apply path dispatches that one)
+        scratch_ring = jnp.zeros(APPLY_RING + 1, dtype=jnp.uint64)
         for k in (5, 2):  # 5 -> the 16-slot stepper, 2 -> the 4-slot
             items = []
             for j in range(k):
@@ -261,50 +416,111 @@ class DualLedger:
                 ns[:k] = [len(a) for _, a in items]
                 active = np.zeros(g.k, dtype=bool)
                 active[:k] = True
-                _fold_group_fn(g.k, g.n_pad)(
-                    jnp.uint64(0), g.results, jnp.asarray(ns),
-                    jnp.asarray(active),
-                )
-        # the shadow's fold kernel
-        chk = jax.jit(fold_reply_codes)(
-            jnp.uint64(0),
-            jnp.zeros(pad + 1, dtype=jnp.uint32),
-            jnp.int32(1),
-        )
+                if self.follower:
+                    idxs = np.arange(g.k, dtype=np.int32)
+                    _, scratch_ring = _fold_group_ring_fn(g.k, g.n_pad)(
+                        jnp.uint64(0), scratch_ring, jnp.asarray(idxs),
+                        g.results, jnp.asarray(ns), jnp.asarray(active),
+                    )
+                else:
+                    _fold_group_fn(g.k, g.n_pad)(
+                        jnp.uint64(0), g.results, jnp.asarray(ns),
+                        jnp.asarray(active),
+                    )
+        # the solo fold kernel
+        if self.follower:
+            chk, scratch_ring = _fold_ring_fn()(
+                jnp.uint64(0), scratch_ring, jnp.int32(0),
+                jnp.zeros(pad + 1, dtype=jnp.uint32), jnp.int32(1),
+            )
+        else:
+            chk = jax.jit(fold_reply_codes)(
+                jnp.uint64(0),
+                jnp.zeros(pad + 1, dtype=jnp.uint32),
+                jnp.int32(1),
+            )
         # block WITHOUT fetching: any device->host read here would
         # permanently degrade this process's tunnel transport before the
         # server ever serves (the whole reason the dual mode exists)
         jax.block_until_ready(chk)
 
-    # -- the device shadow ------------------------------------------------
+    # -- the device apply loop --------------------------------------------
 
-    def _shadow_loop(self) -> None:
+    def _apply_loop(self) -> None:
+        """One loop serves both modes (the generalized shadow loop): items
+        are (op, operation, ts, arr, codes, prepare_checksum) — shadow
+        mode enqueues op=None/codes=None (digests fold via the engine
+        done-callbacks instead), follower mode carries the committed op
+        number, the native dense codes, and the prepare checksum. Control
+        items (first element a str) re-seed/reset the device between
+        runs."""
         import time as _time
 
         import jax
         import jax.numpy as jnp
 
-        from tigerbeetle_tpu.models.ledger import DeviceLedger, fold_reply_codes
+        from tigerbeetle_tpu.models.ledger import (
+            DeviceLedger,
+            fold_reply_codes,
+            fold_reply_codes_np,
+        )
 
         fold = jax.jit(fold_reply_codes)
         chk = jnp.uint64(0)
+        chk_nat = 0
+        # +1: the DUMP slot inactive group lanes scatter into (see
+        # _fold_group_ring_fn); real ops land in [0, APPLY_RING)
+        dev_ring = (
+            jnp.zeros(APPLY_RING + 1, dtype=jnp.uint64)
+            if self.follower else None
+        )
         group_max = DeviceLedger.GROUP_KS[0]
         prev_flat = None  # previous fused group's results (overlap probe)
         stop = False
+
+        def note_applied(op: int | None, n_items: int) -> None:
+            if op is not None:
+                self._applied_op = op
+                self._done_ops += n_items
+                self._lag_gauge.set(max(0, self._enq_ops - self._done_ops))
+
+        def fold_native_run(items) -> None:
+            """Chain the native codes + ring entries for a run, in op
+            order (follower mode; runs are consumed in queue order so the
+            chain matches the commit stream)."""
+            nonlocal chk_nat
+            for op2, _o, _t, _a, codes, prep in items:
+                chk_nat = fold_reply_codes_np(chk_nat, codes)
+                self._op_ring[op2 % APPLY_RING] = (op2, prep, chk_nat)
+
         while not stop:
             t_wait = _time.perf_counter()
             run = [self._q.get()]
             self.shadow_stats.add("idle_s", _time.perf_counter() - t_wait)
             if run[0] is _STOP:
                 break
+            if isinstance(run[0][0], str):  # control item
+                kind = run[0][0]
+                if kind == _INSTALL:
+                    try:
+                        chk, chk_nat, dev_ring = self._apply_install(
+                            run[0][1], dev_ring
+                        )
+                    except Exception as e:
+                        self._shadow_error = e
+                self._consumed_seq += 1
+                with self._apply_cond:
+                    self._apply_cond.notify_all()
+                continue
             # drain a run of queued create_transfers batches: one fused
             # group dispatch covers up to GROUP_KS[0] of them — per-batch
-            # host work (hazard analysis, upload, launch) is the shadow's
+            # host work (hazard analysis, upload, launch) is the loop's
             # dominant cost on a single-core host, and it shares that core
             # with the reply-serving event loop
+            deferred_control = None
             while (
                 len(run) < group_max
-                and run[-1][0] == Operation.create_transfers
+                and run[-1][1] == Operation.create_transfers
             ):
                 try:
                     nxt = self._q.get_nowait()
@@ -313,17 +529,41 @@ class DualLedger:
                 if nxt is _STOP:
                     stop = True
                     break
+                if isinstance(nxt[0], str):
+                    # a control item partitions the run: apply the run
+                    # first, then handle it below — queue order preserved
+                    deferred_control = nxt
+                    break
                 run.append(nxt)
+            if self._test_apply_delay_s:
+                _time.sleep(self._test_apply_delay_s)
             if self._shadow_error is not None or self._restored:
+                self._consumed_seq += len(run) + (
+                    1 if deferred_control is not None else 0
+                )
+                note_applied(run[-1][0], len(run))
+                with self._apply_cond:
+                    self._apply_cond.notify_all()
                 continue  # drain without applying; finalize reports why
             try:
+                if self._test_corrupt_apply_op is not None:
+                    # seeded divergence injection (hash-log check tests):
+                    # corrupt the DEVICE applier's view of one op's rows
+                    run = [
+                        (
+                            item
+                            if item[0] != self._test_corrupt_apply_op
+                            else self._corrupt_item(item)
+                        )
+                        for item in run
+                    ]
                 i = 0
                 while i < len(run):
                     # longest create_transfers stretch from i
                     j = i
                     while (
                         j < len(run)
-                        and run[j][0] == Operation.create_transfers
+                        and run[j][1] == Operation.create_transfers
                     ):
                         j += 1
                     pendings = None
@@ -332,19 +572,47 @@ class DualLedger:
                         with self.tracer.span("shadow.upload",
                                               batches=j - i):
                             pendings = self.device.try_execute_group_async(
-                                [(t, a) for _, t, a in run[i:j]]
+                                [(t, a) for _, _, t, a, _, _ in run[i:j]]
                             )
                     if pendings is not None:
                         g = pendings[0].group
                         m = j - i
                         ns = np.zeros(g.k, dtype=np.int32)
-                        ns[:m] = [len(a) for _, _, a in run[i:j]]
+                        ns[:m] = [len(a) for _, _, _, a, _, _ in run[i:j]]
                         active = np.zeros(g.k, dtype=bool)
                         active[:m] = True
-                        chk = _fold_group_fn(g.k, g.n_pad)(
-                            chk, g.results, jnp.asarray(ns),
-                            jnp.asarray(active),
-                        )
+                        if self.follower:
+                            idxs = np.full(
+                                g.k, APPLY_RING, dtype=np.int32
+                            )  # inactive lanes -> the dump slot
+                            idxs[:m] = [
+                                it[0] % APPLY_RING for it in run[i:j]
+                            ]
+                            # two ACTIVE ops in one run congruent mod
+                            # APPLY_RING (>4096 non-create ops between
+                            # them): duplicate-index scatter is order-
+                            # undefined, so route all but the LAST to
+                            # the dump slot — the host ring keeps the
+                            # last op per slot too (dict overwrite)
+                            seen_slots: dict[int, int] = {}
+                            for lane in range(m):
+                                s_prev = seen_slots.get(int(idxs[lane]))
+                                if s_prev is not None:
+                                    idxs[s_prev] = APPLY_RING
+                                seen_slots[int(idxs[lane])] = lane
+                            chk, dev_ring = _fold_group_ring_fn(
+                                g.k, g.n_pad
+                            )(
+                                chk, dev_ring, jnp.asarray(idxs),
+                                g.results, jnp.asarray(ns),
+                                jnp.asarray(active),
+                            )
+                            fold_native_run(run[i:j])
+                        else:
+                            chk = _fold_group_fn(g.k, g.n_pad)(
+                                chk, g.results, jnp.asarray(ns),
+                                jnp.asarray(active),
+                            )
                         self._shadow_batches += m
                         stats = self.shadow_stats
                         stats.add("batches", m)
@@ -355,6 +623,10 @@ class DualLedger:
                             # while the previous kernel was still running:
                             # the upload pipeline overlapped execution
                             stats.add("overlapped")
+                        if self.follower and stats["groups"]:
+                            self._overlap_gauge.set(round(
+                                stats["overlapped"] / stats["groups"], 4
+                            ))
                         prev_flat = g.results
                     else:
                         # fusion refused (a batch failed the fast-tier
@@ -367,36 +639,170 @@ class DualLedger:
                         t_stage = _time.perf_counter()
                         with self.tracer.span("shadow.upload",
                                               batches=end - i, solo=True):
-                            for op2, ts2, arr2 in run[i:end]:
+                            for op2, opn2, ts2, arr2, _c, _p in run[i:end]:
                                 pending = self.device.execute_async(
-                                    op2, ts2, arr2
+                                    opn2, ts2, arr2
                                 )
-                                chk = fold(
-                                    chk, pending.results,
-                                    jnp.int32(len(arr2)),
-                                )
+                                if self.follower:
+                                    chk, dev_ring = _fold_ring_fn()(
+                                        chk, dev_ring,
+                                        jnp.int32(op2 % APPLY_RING),
+                                        pending.results,
+                                        jnp.int32(len(arr2)),
+                                    )
+                                else:
+                                    chk = fold(
+                                        chk, pending.results,
+                                        jnp.int32(len(arr2)),
+                                    )
                                 self._shadow_batches += 1
                                 self.shadow_stats.add("batches")
                                 self.shadow_stats.add("solo")
+                        if self.follower:
+                            fold_native_run(run[i:end])
                         self.shadow_stats.add(
                             "stage_s", _time.perf_counter() - t_stage)
                         j = end
                     i = j
             except Exception as e:  # divergence surfaces at finalize
                 self._shadow_error = e
-        # written once at shadow-loop exit; finalize() joins before reading
+            self._consumed_seq += len(run)
+            note_applied(run[-1][0], len(run))
+            if deferred_control is not None:
+                if deferred_control[0] == _INSTALL:
+                    try:
+                        chk, chk_nat, dev_ring = self._apply_install(
+                            deferred_control[1], dev_ring
+                        )
+                    except Exception as e:
+                        self._shadow_error = e
+                self._consumed_seq += 1
+            with self._apply_cond:
+                self._apply_cond.notify_all()
+        # written once at apply-loop exit; finalize() joins before reading
         self._chk_device_scalar = chk  # vet: handoff
+        self._chk_native_thread = chk_nat
+        self._dev_ring_out = dev_ring
+
+    @staticmethod
+    def _corrupt_item(item):
+        """Test hook payload: reroute EVERY lane's debit account (or
+        ledger) to a nonexistent/invalid value so any valid lane's DEVICE
+        reply code diverges from the native engine's (the exact failure
+        the hash-log ring must localize). Whole-batch corruption — a
+        single-lane flip could land on an event that was already invalid
+        and change nothing."""
+        op2, opn2, ts2, arr2, codes, prep = item
+        bad = arr2.copy()
+        if opn2 == Operation.create_transfers:
+            bad["debit_account_id_lo"][:] = 0xDEAD_BEEF_DEAD_BEEF
+            bad["debit_account_id_hi"][:] = 0xDEAD_BEEF_DEAD_BEEF
+        else:
+            bad["ledger"][:] = 0  # ledger_must_not_be_zero on valid lanes
+        return (op2, opn2, ts2, bad, codes, prep)
+
+    def _apply_install(self, raw: bytes, dev_ring):
+        """Handle an _INSTALL control item ON the apply thread: re-seed
+        the device tables from a native snapshot's row images
+        (DeviceLedger.install_snapshot_rows — h2d only) and reset both
+        digest chains/rings: the chains cover the op stream SINCE this
+        state, exactly like the native side's restored tables."""
+        import jax.numpy as jnp
+
+        # install items are only ever enqueued in follower mode
+        # (restore_bytes); both exits restart the chains/rings from the
+        # installed state
+        fresh_chains = (
+            jnp.uint64(0), 0, jnp.zeros(APPLY_RING + 1, dtype=jnp.uint64),
+        )
+        accounts, transfers, fulfill, commit_ts = _parse_native_snapshot(raw)
+        if (
+            len(accounts) > self.device._acct_limit
+            or len(transfers) > self.device._xfer_limit
+        ):
+            # snapshot exceeds the device geometry: stand down (finalize
+            # reports skipped) rather than overflow the probe windows
+            self._restored = True
+            return fresh_chains
+        # a mid-run state-sync jump installs onto a device that already
+        # holds applied rows: reset to fresh first (claim_slots would
+        # otherwise give every already-present key a SECOND slot and the
+        # occupancy trackers would double-count)
+        self.device.reset_state()
+        self.hazards = self.device.hazards  # vet: handoff
+        self.device.install_snapshot_rows(
+            accounts, transfers, fulfill, commit_ts
+        )
+        for i in range(APPLY_RING):
+            self._op_ring[i] = None
+        return fresh_chains
+
+    # -- follower apply seam (driven by the replica at commit finalize) ----
+
+    def apply_commit(
+        self,
+        op: int,
+        operation: Operation,
+        timestamp: int,
+        arr: np.ndarray,
+        codes: np.ndarray,
+        prepare_checksum: int = 0,
+    ) -> None:
+        """Enqueue one COMMITTED op for the device applier (follower
+        mode): called by the replica at commit finalize, in op order,
+        with the event rows (a read-only view over the prepare body) and
+        the native engine's dense reply codes. The bounded queue
+        backpressures the event loop only as a last resort — admission
+        throttling via apply_lag_excess() engages first."""
+        assert self.follower
+        self._enqueued_op = op
+        self._enq_ops += 1
+        self._put_seq += 1
+        self._q.put((op, operation, timestamp, arr, codes, prepare_checksum))
+
+    def apply_lag_ops(self) -> int:
+        """Committed-but-not-yet-device-applied CREATE ops (enqueued
+        items minus consumed items — one item per committed create op;
+        op-number distance would misread interleaved lookups/registers
+        and the post-restart op jump as phantom lag). Applied means
+        dispatched to the device: the kernels execute in stream order
+        behind it, and nothing on the host ever waits on them."""
+        return max(0, self._enq_ops - self._done_ops)
+
+    def apply_lag_excess(self) -> int:
+        """Lag beyond the admission window — the saturation signal
+        Replica.ingress_occupancy adds to its pipeline occupancy so the
+        credit regulator sheds before the apply queue's put() blocks."""
+        return max(0, self.apply_lag_ops() - self.lag_window)
+
+    def drain_applier(self, timeout: float = 600.0) -> bool:
+        """Block until every enqueued item (ops and control items) has
+        been consumed by the apply loop — the checkpoint/state-sync
+        barrier. Returns False on timeout or a dead apply thread."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._apply_cond:
+            while self._consumed_seq < self._put_seq:
+                if not self._thread.is_alive():
+                    return False
+                left = deadline - _time.monotonic()
+                if left <= 0 or not self._apply_cond.wait(timeout=min(left, 1.0)):
+                    if _time.monotonic() >= deadline:
+                        return False
+        return True
 
     def _enqueue_shadow(self, operation, timestamp: int, arr) -> None:
         # the queue bounds host-memory growth; a full queue briefly
         # backpressures the event loop rather than dropping shadow batches
         # (a dropped batch would be an unverifiable run, not a fast one)
-        self._q.put((operation, timestamp, arr))
+        self._q.put((None, operation, timestamp, arr, None, 0))
 
     def _fold_native(self, pending) -> None:
         """Chain the native codes into the host-side digest when the engine
         worker completes the batch (FIFO worker => stream order matches the
-        shadow queue's)."""
+        shadow queue's). Shadow mode only — the follower folds on the
+        apply thread with op numbers instead."""
         from tigerbeetle_tpu.models.ledger import fold_reply_codes_np
 
         def _cb(_fut, codes=pending.codes):
@@ -421,6 +827,8 @@ class DualLedger:
     def execute_async(self, operation, timestamp: int, events):
         arr = events if isinstance(events, np.ndarray) else None
         pending = self.native.execute_async(operation, timestamp, events)
+        if self.follower:
+            return pending  # the replica enqueues at commit finalize
         if operation in (Operation.create_accounts, Operation.create_transfers):
             if arr is None:
                 # list-of-objects path (REPL/tests): reuse the bytes the
@@ -440,9 +848,10 @@ class DualLedger:
         pendings = self.native.try_execute_group_async(items)
         if pendings is None:
             return None
-        for (ts, arr), p in zip(items, pendings):
-            self._fold_native(p)
-            self._enqueue_shadow(Operation.create_transfers, ts, arr)
+        if not self.follower:
+            for (ts, arr), p in zip(items, pendings):
+                self._fold_native(p)
+                self._enqueue_shadow(Operation.create_transfers, ts, arr)
         return pendings
 
     def drain(self, pending):
@@ -482,16 +891,29 @@ class DualLedger:
 
     def restore_bytes(self, raw: bytes) -> None:
         self.native.restore_bytes(raw)
-        # The device table cannot be rebuilt from a mid-history snapshot
-        # without a row-level upload path; the shadow stands down and
-        # finalize() reports it (bench/format-fresh runs never hit this).
+        if self.follower:
+            # Re-seed the device from the SAME snapshot's row images (the
+            # row-level upload path: h2d staging + insert kernels, no
+            # d2h) — queued as a control item so it serializes with any
+            # in-flight applies; the replica drains the applier before
+            # any state-replacing restore (checkpoint/state-sync
+            # contract). Digest chains/rings reset with the state.
+            if len(raw) <= 64:
+                return  # fresh/empty snapshot: nothing to install
+            self._put_seq += 1
+            self._q.put((_INSTALL, raw))
+            return
+        # Shadow mode: the device table cannot be rebuilt from a
+        # mid-history snapshot (no op-tagged apply seam); the shadow
+        # stands down and finalize() reports it (bench/format-fresh runs
+        # never hit this).
         if len(raw) > 64 and self.native.counts()["accounts"] > 0:
             self._restored = True
 
     # -- shutdown verification --------------------------------------------
 
     def _shadow_report(self) -> dict:
-        """Shadow-loop cost/overlap summary for the [stats] line. The
+        """Apply-loop cost/overlap summary for the [stats] line. The
         upload_overlap ratio is the fraction of fused groups whose staging
         + dispatch completed while the previous group's kernel was still
         executing — 1.0 means the h2d path never waited on the device."""
@@ -501,12 +923,46 @@ class DualLedger:
         s["upload_overlap"] = (
             round(s["overlapped"] / s["groups"], 4) if s["groups"] else None
         )
+        if self.follower:
+            s["applied_op"] = self._applied_op
+            s["lag_ops"] = self.apply_lag_ops()
         return s
 
+    def _hash_ring_check(self) -> dict:
+        """Walk the host/device per-op digest rings (one ring fetch — the
+        finalize-time d2h) and name the FIRST divergent op, the
+        -Dhash-log-mode check across engines. Only meaningful in follower
+        mode (shadow mode has no op numbers)."""
+        dev = np.asarray(self._dev_ring_out)
+        entries = sorted(
+            (e for e in self._op_ring if e is not None), key=lambda e: e[0]
+        )
+        first = None
+        want = got = prep = 0
+        for op, prep_chk, nat_chk in entries:
+            dv = int(dev[op % APPLY_RING])
+            if dv != nat_chk:
+                first, want, got, prep = op, nat_chk, dv, prep_chk
+                break
+        return {
+            "ops": len(entries),
+            "ok": first is None,
+            "first_divergent_op": first,
+            **(
+                # the op's PREPARE checksum ties the divergence back to
+                # the consensus stream (hash_log's prepare half): grep it
+                # in a --hash-log recording / the WAL to find the exact
+                # batch both engines executed
+                {"want": want, "got": got, "prepare": f"{prep:#x}"}
+                if first is not None else {}
+            ),
+        }
+
     def finalize(self, timeout: float = 600.0) -> dict:
-        """Drain the shadow, then do the process's FIRST d2h reads: compare
-        the two reply-code digests and the two state fingerprints. Returns
-        the verification report the server prints on its [stats] line."""
+        """Drain the apply queue, then do the process's FIRST d2h reads:
+        compare the two reply-code digests, the per-op digest rings
+        (follower mode), and the two state fingerprints. Returns the
+        verification report the server prints on its [stats] line."""
         self._q.put(_STOP)
         self._thread.join(timeout=timeout)
         if self._thread.is_alive():
@@ -531,14 +987,18 @@ class DualLedger:
                 "error": f"{type(e).__name__}: {e}",
             }
         chk_dev = int(np.asarray(self._chk_device_scalar))
-        # Barrier through the engine's FIFO worker: a job submitted now
-        # starts only after every prior execute's future has resolved AND
-        # run its inline done-callbacks (the fold chain) on the worker
-        # thread — Future.result() alone wakes waiters BEFORE callbacks,
-        # so without this the last batch's fold could be missing.
-        self.native._submit(lambda: 0).result()
-        with self._chk_lock:
-            chk_nat = self._chk_native
+        if self.follower:
+            chk_nat = self._chk_native_thread
+        else:
+            # Barrier through the engine's FIFO worker: a job submitted
+            # now starts only after every prior execute's future has
+            # resolved AND run its inline done-callbacks (the fold chain)
+            # on the worker thread — Future.result() alone wakes waiters
+            # BEFORE callbacks, so without this the last batch's fold
+            # could be missing.
+            self.native._submit(lambda: 0).result()
+            with self._chk_lock:
+                chk_nat = self._chk_native
         fp_nat = self.native.fingerprint()
         fp_dev = self.device.fingerprint()
         ok = (
@@ -549,7 +1009,7 @@ class DualLedger:
             and fp_nat["transfers"] == fp_dev["transfers"]
             and fp_nat["commit_timestamp"] == fp_dev["commit_timestamp"]
         )
-        return {
+        report = {
             "verified": bool(ok),
             "shadow_batches": self._shadow_batches,
             "shadow": self._shadow_report(),
@@ -557,3 +1017,45 @@ class DualLedger:
             "fingerprint_native": fp_nat,
             "fingerprint_device": fp_dev,
         }
+        if self.follower and self._dev_ring_out is not None:
+            report["hash_log"] = self._hash_ring_check()
+            if not report["hash_log"]["ok"]:
+                report["verified"] = False
+        return report
+
+
+def _parse_native_snapshot(raw: bytes):
+    """Decode the native engine's snapshot blob (native/ledger.cc
+    tb_ledger_snapshot layout: 64-byte header, live account rows, live
+    transfer rows, posted {ts, val} pairs) into the wire-row arrays +
+    per-transfer fulfill column DeviceLedger.install_snapshot_rows
+    ingests. Host-side numpy only."""
+    from tigerbeetle_tpu import types
+
+    head = np.frombuffer(raw[:64], dtype=np.uint64)
+    n_a, n_t, n_p = int(head[0]), int(head[1]), int(head[2])
+    commit_ts = int(head[3])
+    off = 64
+    accounts = np.frombuffer(
+        raw[off : off + n_a * 128], dtype=types.ACCOUNT_DTYPE
+    )
+    off += n_a * 128
+    transfers = np.frombuffer(
+        raw[off : off + n_t * 128], dtype=types.TRANSFER_DTYPE
+    )
+    off += n_t * 128
+    posted = np.frombuffer(
+        raw[off : off + n_p * 16], dtype=np.uint64
+    ).reshape(n_p, 2)
+    # posted pairs key the PENDING transfer by its timestamp; the device
+    # keeps the same fact in the fulfill column 1:1 with transfer rows
+    fulfill = np.zeros(n_t, dtype=np.uint32)
+    if n_p and n_t:
+        order = np.argsort(posted[:, 0])
+        pts = posted[order, 0]
+        pvals = posted[order, 1]
+        idx = np.searchsorted(pts, transfers["timestamp"])
+        idxc = np.minimum(idx, len(pts) - 1)
+        match = pts[idxc] == transfers["timestamp"]
+        fulfill = np.where(match, pvals[idxc], 0).astype(np.uint32)
+    return accounts, transfers, fulfill, commit_ts

@@ -2095,6 +2095,139 @@ class DeviceLedger(HostLedgerBase):
         Synchronizes with the device — amortize on the hot path."""
         raise_on_fault(int(np.asarray(self.state["fault"])), "device ledger")
 
+    # ------------------------------------------------------------------
+    # snapshot row install (the dual follower's restore path)
+    # ------------------------------------------------------------------
+
+    INSTALL_CHUNK = 8192  # rows per install upload (one compile per table)
+
+    def reset_state(self) -> None:
+        """Drop every table back to fresh (the install path's
+        precondition): a state-sync jump installs a snapshot onto a
+        device that already holds applied rows — claim_slots would give
+        each already-present key a SECOND slot and the occupancy
+        trackers would double-count. In-flight kernels keep their
+        references to the old arrays (functional updates), so this is
+        safe to run between dispatches."""
+        self.state = init_state(self.process)
+        self._acct_used = 0
+        self._xfer_used = 0
+        self.hazards = HazardTracker()
+
+    def _install_fn(self, table: str):
+        """Jitted chunk installer for one table: claim slots for `n` wire
+        rows and scatter them in (h2d upload + insert kernels ONLY — no
+        device->host read; install failures set the sticky fault word and
+        surface at the caller's next check_fault). `ful` carries the
+        per-row posted/voided resolution for transfers (ignored for
+        accounts — the column is scattered into the dump slot)."""
+        cache = getattr(self.kernels, "_install_cache", None)
+        if cache is None:
+            cache = self.kernels._install_cache = {}
+        fn = cache.get(table)
+        if fn is None:
+            log2 = self.kernels.a_log2 if table == "acct" else self.kernels.t_log2
+            dump = jnp.int32(1 << log2)
+            rows_key = f"{table}_rows"
+            claim_key = f"{table}_claim"
+            count_key = "acct_count" if table == "acct" else "xfer_count"
+            used_key = (
+                "acct_used_slots" if table == "acct" else "xfer_used_slots"
+            )
+            is_xfer = table == "xfer"
+
+            def f(state, rows_b, ful, n):
+                active = jnp.arange(rows_b.shape[0], dtype=jnp.int32) < n
+                slots, claim, resolved = ht.claim_slots(
+                    rows_b[:, :4], active, state[rows_key],
+                    state[claim_key], log2,
+                )
+                ok = active & resolved
+                w = jnp.where(ok, slots, dump)
+                out = dict(state)
+                out[rows_key] = state[rows_key].at[w].set(rows_b)
+                out[claim_key] = claim
+                if is_xfer:
+                    out["fulfill"] = state["fulfill"].at[w].set(ful)
+                nn = jnp.sum(ok.astype(jnp.uint64))
+                out[count_key] = state[count_key] + nn
+                out[used_key] = state[used_key] + nn
+                # an unresolved active lane (probe-window overflow) is an
+                # unrecoverable install: sticky fault, checked at finalize
+                out["fault"] = state["fault"] | jnp.where(
+                    jnp.any(active & ~resolved), jnp.uint32(1 << 30),
+                    jnp.uint32(0),
+                )
+                return out
+
+            fn = cache[table] = jax.jit(f, donate_argnums=(0,))
+        return fn
+
+    def install_snapshot_rows(
+        self,
+        accounts: np.ndarray,
+        transfers: np.ndarray,
+        fulfill: np.ndarray,
+        commit_timestamp: int,
+    ) -> None:
+        """Rebuild the device tables from host-side 128-byte wire row
+        images (the native engine's snapshot format parses to exactly
+        these) — the row-level upload path the dual follower uses to
+        re-seed the device after a checkpoint restore or state-sync jump.
+        Precondition: fresh (empty) device state. `fulfill` is the
+        per-transfer posted/voided column (0 = unresolved), aligned with
+        `transfers`. H2d staging and insert kernels only: no d2h."""
+        assert len(fulfill) == len(transfers)
+        ch = self.INSTALL_CHUNK
+        for table, arr, ful in (
+            ("acct", accounts, None),
+            ("xfer", transfers, fulfill),
+        ):
+            fn = self._install_fn(table)
+            for i in range(0, len(arr), ch):
+                part = arr[i : i + ch]
+                n = len(part)
+                rows_b = jnp.asarray(_to_rows_np(part, ch))
+                fv = np.zeros(ch, dtype=np.uint32)
+                if ful is not None:
+                    fv[:n] = ful[i : i + n]
+                self.state = fn(
+                    self.state, rows_b, jnp.asarray(fv), jnp.int32(n)
+                )
+        # device-side commit clock + host-side occupancy/hazard rebuild
+        self.state["commit_ts"] = jnp.uint64(commit_timestamp)
+        self._acct_used += len(accounts)
+        self._xfer_used += len(transfers)
+        self.hazards.note_limit_accounts(accounts)
+        if len(transfers):
+            # conservative superset of live pendings (extra entries only
+            # degrade later post/void batches to the serial tier)
+            pen = (transfers["flags"] & np.uint16(F_PENDING)) != 0
+            for idl, idh, dl, cl in zip(
+                transfers["id_lo"][pen], transfers["id_hi"][pen],
+                transfers["debit_account_id_lo"][pen],
+                transfers["credit_account_id_lo"][pen],
+            ):
+                self.hazards.pending_accounts[
+                    int(idl) | (int(idh) << 64)
+                ] = (int(dl), int(cl))
+        # amount_sum is the proof bound "no balance can exceed this": the
+        # sum of every restored posted+pending balance is an upper bound
+        # on any restored balance, and future batches keep adding theirs
+        for col in (
+            "debits_posted", "credits_posted",
+            "debits_pending", "credits_pending",
+        ):
+            if len(accounts):
+                lo = accounts[col + "_lo"]
+                hi = accounts[col + "_hi"]
+                self.hazards.amount_sum += (
+                    int(np.sum(lo & np.uint64(0xFFFFFFFF), dtype=np.uint64))
+                    + (int(np.sum(lo >> np.uint64(32), dtype=np.uint64)) << 32)
+                    + ((int(np.sum(hi & np.uint64(0xFFFFFFFF), dtype=np.uint64))
+                        + (int(np.sum(hi >> np.uint64(32), dtype=np.uint64)) << 32)) << 64)
+                )
+
     def drain(self, pending: PendingBatch) -> list[int]:
         """Materialize a pending batch's dense result codes; reconciles the
         conservative occupancy charge to the exact ever-applied insert count

@@ -19,6 +19,17 @@ import json
 from tigerbeetle_tpu import native
 
 
+def parse_hash_log_spec(spec: str) -> tuple[str, str]:
+    """CLI surface parser (``start --hash-log``, ``vopr.py --hash-log``):
+    ``record:<path>`` | ``check:<path>`` | bare ``<path>`` (records) ->
+    (mode, path). The reference arms the same pair via -Dhash-log-mode
+    (src/config.zig:195-199)."""
+    mode, sep, path = spec.partition(":")
+    if sep and mode in ("record", "check"):
+        return mode, path
+    return "record", spec
+
+
 class HashLogDivergence(AssertionError):
     def __init__(self, op: int, kind: str, want: int, got: int):
         super().__init__(
@@ -40,6 +51,11 @@ class HashLog:
         self.path = path
         # op -> (prepare_checksum, reply_body_checksum | None)
         self.entries: dict[int, list] = {}
+        # ops THIS RUN actually streamed/verified (check mode preloads
+        # `entries` from the recording, so len(entries) says nothing
+        # about replay coverage — a truncated replay must not read as
+        # fully checked)
+        self._seen: set[int] = set()
         if mode == "check":
             assert path is not None, "check mode needs a recording"
             with open(path) as f:
@@ -49,6 +65,13 @@ class HashLog:
                         int(rec["prepare"], 16),
                         int(rec["reply"], 16) if rec["reply"] else None,
                     ]
+
+    @property
+    def ops_seen(self) -> int:
+        """Distinct ops this run recorded (record mode) or replayed
+        against the recording (check mode) — the coverage number a
+        surface should report, NOT len(entries)."""
+        return len(self._seen)
 
     # -- wiring --
 
@@ -74,6 +97,7 @@ class HashLog:
     # -- the stream --
 
     def note_prepare(self, op: int, checksum: int) -> None:
+        self._seen.add(op)
         if self.mode == "record":
             self.entries.setdefault(op, [None, None])[0] = checksum
             return

@@ -393,6 +393,7 @@ class Simulator:
         cdc_fanout_throttle: int = 4,
         ingress_gateway: bool = False,
         storm_clients: int = 0,
+        hash_log: tuple[str, str] | None = None,
     ):
         from tigerbeetle_tpu.constants import TEST_PROCESS
 
@@ -419,6 +420,16 @@ class Simulator:
         # retry through — set before the replica loop so restarted
         # replicas get their gateway back too.
         self.ingress_gateway = ingress_gateway
+        # hash_log debugging surface (testing/hash_log.py; the reference's
+        # -Dhash-log-mode): ("record"|"check", path). ONE log instance
+        # lives across replica 0's crash/restarts — recovery re-commits
+        # re-record/re-check identical entries (idempotent by op), and
+        # check mode dies AT the first divergent op of a replayed seed.
+        self.hash_log = None
+        if hash_log is not None:
+            from tigerbeetle_tpu.testing.hash_log import HashLog
+
+            self.hash_log = HashLog(hash_log[0], path=hash_log[1])
         self.seed = seed
         self.rng = random.Random(seed)
         self.ticks_budget = ticks
@@ -571,6 +582,11 @@ class Simulator:
             )
 
         r.commit_hook = hook
+        if i == 0 and self.hash_log is not None:
+            # chains AFTER the history hook (attach composes); replica 0
+            # only — every replica commits the same stream, and one
+            # recording per seed is the reference's shape too
+            self.hash_log.attach(r)
         r.cdc_retain = self.cdc_enabled  # restarts keep the reply ring on
         if i == 0 and getattr(self, "_fanout_aof", None) is not None:
             # the fan-out tail's deep-resume source; reopened append-only
@@ -829,6 +845,11 @@ class Simulator:
             # exactly the artifact worth diffing against a healthy replay
             if self.tracer is not None and self.trace_path is not None:
                 self.tracer.dump(self.trace_path)
+            # ...and a failing seed's hash-log recording is the artifact a
+            # replay checks against (save in the finally for the same
+            # reason the trace dumps there)
+            if self.hash_log is not None and self.hash_log.mode == "record":
+                self.hash_log.save()
             if self._fanout_aof is not None:
                 import os as _os
 
@@ -855,6 +876,11 @@ class Simulator:
             ].refusals
         if self.storm_clients:
             out_cdc["storm_clients"] = self.storm_clients
+        if self.hash_log is not None:
+            out_cdc["hash_log_mode"] = self.hash_log.mode
+            # ops THIS RUN streamed/verified — in check mode len(entries)
+            # is the preloaded recording and says nothing about coverage
+            out_cdc["hash_log_ops"] = self.hash_log.ops_seen
         return {
             "seed": self.seed,
             "committed_ops": committed,

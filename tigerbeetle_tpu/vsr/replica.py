@@ -39,6 +39,8 @@ import json as _json
 from collections import deque
 from time import perf_counter_ns
 
+import numpy as np
+
 from tigerbeetle_tpu.constants import ConfigCluster, ConfigProcess
 from tigerbeetle_tpu.io.network import Network
 from tigerbeetle_tpu.io.storage import Storage
@@ -48,7 +50,7 @@ from tigerbeetle_tpu.metrics import Metrics
 from tigerbeetle_tpu.models.ledger import DeviceLedger
 from tigerbeetle_tpu.state_machine import StateMachine
 from tigerbeetle_tpu.tracer import NULL_TRACER
-from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.types import ACCOUNT_DTYPE, TRANSFER_DTYPE, Operation
 from tigerbeetle_tpu.vsr.client_replies import ClientReplies
 from tigerbeetle_tpu.vsr.clock import Clock
 from tigerbeetle_tpu.vsr.durable import (
@@ -162,6 +164,13 @@ class Replica:
             # dispatch (a fetch-free driver like the flagship bench must
             # NOT — see DeviceLedger.prefetch_results)
             backend.prefetch_results = True
+        # Dual-commit follower plan (`--backend dual`, models/dual_ledger):
+        # the native engine serves replies while the device applier follows
+        # the committed op stream — this replica enqueues each create op at
+        # commit FINALIZE (apply_commit), drains the applier before any
+        # state-replacing transition, and feeds the applier's bounded-lag
+        # excess into admission (ingress_occupancy / the _on_request cap).
+        self._dual_apply = bool(getattr(backend, "dual_follower", False))
         self.ledger = backend
         # thread the observability seams through the stack: the backend's
         # staging fences, the spill pipeline (prefetch/admit/cycle spans)
@@ -223,6 +232,17 @@ class Replica:
         # hold; commit_window == 0 (deterministic tests) never defers.
         self.fuse_window_ns = 2_000_000
         self._fuse_started: int | None = None
+        # Fuse-window AUTOTUNE (opt-in; the server CLI turns it on by
+        # default): AIMD on hold outcomes — a hold that EXPIRES with its
+        # run still short means arrivals are spaced wider than the window
+        # (widen ×1.25); a run that fills to GROUP_MAX while a hold is
+        # open means the window over-covers the arrival spacing (shrink
+        # ×0.95 to shed hold latency). Bounded so a quiet wire cannot
+        # climb the window into client-visible latency. Only active with
+        # commit_window > 0 (deterministic harnesses never hold).
+        self.fuse_autotune = False
+        self.fuse_window_min_ns = 500_000
+        self.fuse_window_max_ns = 8_000_000
         self._inflight: deque[dict] = deque()
         # grid repair state: forest-block addresses awaiting peer repair
         # (reference: src/vsr/grid_blocks_missing.zig)
@@ -235,7 +255,14 @@ class Replica:
         # A registry-backed Mapping: readers keep dict access, the storage
         # lives in self.metrics (the shared pipeline registry).
         self.group_stats = self.metrics.group(
-            "commit.group", ("fused_ops", "solo_ops", "fused_groups")
+            "commit.group",
+            # fuse_holds/fuse_expired instrument WHY a hit rate is what it
+            # is: holds that expired short mean the window lost the race
+            # against arrival spacing (the autotune's widen signal), while
+            # a high hit rate with zero holds means runs formed without
+            # deferral (the window is irrelevant, not well-tuned)
+            ("fused_ops", "solo_ops", "fused_groups", "fuse_holds",
+             "fuse_expired"),
         )
         # commit-pipeline timing histograms (metrics.py CATALOG for units)
         self._h_quorum = self.metrics.histogram("replica.quorum_wait_us")
@@ -334,7 +361,14 @@ class Replica:
             self.cluster.pipeline_prepare_queue_max, 2 * self.commit_window
         )
         backlog = max(0, len(self._inflight) - max(1, self.commit_window))
-        return len(self.pipeline) + backlog, cap
+        used = len(self.pipeline) + backlog
+        if self._dual_apply:
+            # dual-commit bounded-lag backpressure: device-applier lag
+            # beyond its window counts as occupancy, so the credit
+            # regulator sheds (typed busy replies) BEFORE the bounded
+            # apply queue's put() would stall the event loop
+            used += self.ledger.apply_lag_excess()
+        return used, cap
 
     def _reply_slot_alloc(self) -> int | None:
         """Pop a free client_replies slot (None when every slot is owned
@@ -445,6 +479,12 @@ class Replica:
 
     def _checkpoint(self) -> None:
         self.flush_commits()  # snapshot sees finalized client-table state
+        if self._dual_apply:
+            # dual-commit contract: the device applier drains to the
+            # checkpoint's commit_min before the snapshot is cut, so the
+            # checkpoint never races an in-flight device apply and the
+            # applier's lag is re-bounded at every checkpoint
+            self._drain_applier_checked("checkpoint")
         # Queued reply-slot writes must land before the client table (with
         # their checksums) is persisted: a crash after the superblock commit
         # but before a queued write would record a reply_checksum for bytes
@@ -502,6 +542,22 @@ class Replica:
             "checkpoint flags a client_table blob but the superblock "
             "references none"
         )
+
+    def _drain_applier_checked(self, where: str) -> None:
+        """Drain the dual-commit device applier and make a timeout LOUD:
+        proceeding with applies still in flight breaks the
+        drain-before-snapshot/restore contract, and a later parity
+        failure at finalize would be undebuggable back to this cause
+        without the record."""
+        if not self.ledger.drain_applier():
+            self.metrics.counter("shadow.drain_timeouts").add()
+            import sys as _sys
+
+            _sys.stderr.write(
+                f"[dual] WARNING: device applier drain timed out at "
+                f"{where} (lag {self.ledger.apply_lag_ops()} ops) — "
+                "device parity is no longer assured for this run\n"
+            )
 
     def _maybe_checkpoint(self, next_op: int) -> None:
         """WAL-wrap guard: never let a prepare overwrite an op that is not
@@ -796,7 +852,12 @@ class Replica:
         cap = max(
             self.cluster.pipeline_prepare_queue_max, 2 * self.commit_window
         )
-        if len(self.pipeline) >= cap:
+        # Dual-commit mode: device-applier lag beyond its window throttles
+        # admission here too (gateway-less deployments) — the client
+        # retries, the lag stays bounded, the apply queue never wedges the
+        # event loop on a blocking put.
+        lag_excess = self.ledger.apply_lag_excess() if self._dual_apply else 0
+        if len(self.pipeline) + lag_excess >= cap:
             return
 
         op = self.op + 1
@@ -1334,6 +1395,12 @@ class Replica:
             and self._adopt is not None
         )
         self.flush_commits()  # restore replaces the ledger state wholesale
+        if self._dual_apply:
+            # the device applier must quiesce before restore_bytes
+            # replaces its tables (the install rides the apply queue, but
+            # draining first bounds how much queued work the jump makes
+            # moot and keeps the digest-ring reset unambiguous)
+            self._drain_applier_checked("state-sync")
         n = int.from_bytes(body[:8], "little")
         remote = VSRState.from_bytes(body[8 : 8 + n])
         if remote.commit_min <= self.commit_min:
@@ -1468,10 +1535,6 @@ class Replica:
             or header.operation != int(Operation.create_transfers)
         ):
             return
-        import numpy as np
-
-        from tigerbeetle_tpu.types import TRANSFER_DTYPE
-
         spill.prefetch_async(np.frombuffer(body, dtype=TRANSFER_DTYPE))
 
     def _drop_quorum_tokens(self) -> None:
@@ -1758,9 +1821,12 @@ class Replica:
             "reply_body": reply_body,
             "to_client": self.is_primary,
             # prepare body kept through finalize only for the CDC live
-            # tail (a reference the pipeline/journal holds anyway — but
-            # don't pin 1 MiB per in-flight entry when no pump is on)
-            "body": body if self.cdc_hook is not None else None,
+            # tail and the dual-commit device applier (references the
+            # pipeline/journal hold anyway — but don't pin 1 MiB per
+            # in-flight entry when neither consumer is on)
+            "body": body
+            if (self.cdc_hook is not None or self._dual_apply)
+            else None,
         }
 
     def _commit_finalize(self, entry: dict) -> bytes | None:
@@ -1811,6 +1877,30 @@ class Replica:
             # retry path never reaches here twice), in op order (the
             # in-flight queue drains FIFO)
             self.cdc_hook(header, entry.get("body"), reply_body)
+        if (
+            self._dual_apply
+            and header.operation in _CDC_RETAIN_OPS  # the two create ops
+            and isinstance(entry["handle"], tuple)
+        ):
+            # Dual-commit apply seam: the device applier follows the
+            # COMMITTED op stream — enqueue exactly once, at finalize
+            # (reply built, WAL durable), in op order, with the native
+            # engine's dense codes for the host side of the hash-log
+            # ring. Zero-copy: the rows view aliases the prepare body
+            # bytes and the codes array is the one the engine filled.
+            self.ledger.apply_commit(
+                header.op,
+                Operation(header.operation),
+                header.timestamp,
+                np.frombuffer(
+                    entry["body"],
+                    dtype=ACCOUNT_DTYPE
+                    if header.operation == int(Operation.create_accounts)
+                    else TRANSFER_DTYPE,
+                ),
+                entry["handle"][1].codes,
+                prepare_checksum=header.checksum,
+            )
         self.cdc_commit_min = header.op
         wire = reply.to_bytes() + reply_body
         tentry = self.client_table.get(header.client)
@@ -1949,17 +2039,35 @@ class Replica:
                 break
             run += 1
         if run == 0 or run >= self.GROUP_MAX:
+            if run >= self.GROUP_MAX and self._fuse_started is not None \
+                    and self.fuse_autotune:
+                # the held run filled before the window expired: the
+                # window over-covers the arrival spacing — shed a little
+                # hold latency (multiplicative-decrease half of AIMD)
+                self.fuse_window_ns = max(
+                    self.fuse_window_min_ns, int(self.fuse_window_ns * 0.95)
+                )
             self._fuse_clear()
             return False
         now = self.time.monotonic()
         if self._fuse_started is None:
             self._fuse_started = now
+            self.group_stats.add("fuse_holds")
             self._fuse_token = self.tracer.start(
                 "replica.fuse_hold", run=run
             )
             return True
         if now - self._fuse_started < self.fuse_window_ns:
             return True
+        # hold EXPIRED with the run still short: the window lost the race
+        # against this workload's arrival spacing (the r05 driver's 0.46
+        # hit rate vs 0.85 in the CPU A/B was exactly this, invisible
+        # without the counter) — record it, and autotune widens
+        self.group_stats.add("fuse_expired")
+        if self.fuse_autotune:
+            self.fuse_window_ns = min(
+                self.fuse_window_max_ns, int(self.fuse_window_ns * 1.25)
+            )
         self._fuse_clear()
         return False
 
