@@ -1097,16 +1097,20 @@ def main() -> None:
         json.dump(detail, f, indent=1)
     print("detail: " + json.dumps(detail), file=sys.stderr)
     if trace_path:
-        # ONE Perfetto-loadable file: driver spans (pid 0) + the traced
-        # e2e server's commit-pipeline spans (pid 1 — fuse holds, journal
-        # writes, commit dispatch/finalize, shadow uploads)
-        events = TRACER.events_ordered()
-        for e in server_trace_events or []:
-            events.append(dict(e, pid=1))
-        with open(trace_path, "w") as f:
-            json.dump({"traceEvents": events}, f, sort_keys=True,
-                      separators=(",", ":"))
-        print(f"trace: {len(events)} events -> {trace_path}",
+        # ONE Perfetto-loadable file, stitched (tracer.stitch): driver
+        # spans (pid 0) + the traced e2e server's commit-pipeline spans
+        # (pid 1 — fuse holds, journal writes, commit dispatch/finalize,
+        # CDC emits, shadow uploads), with the per-op trace tags turned
+        # into cross-pid FLOW events — clicking an op follows it from
+        # the bus ingress through reply and device apply.
+        from tigerbeetle_tpu.tracer import dump_stitched
+
+        n_events = dump_stitched(
+            trace_path,
+            [TRACER.events_ordered(), server_trace_events or []],
+            labels=["bench driver", "e2e server"],
+        )
+        print(f"trace: {n_events} events (stitched) -> {trace_path}",
               file=sys.stderr)
     print(
         json.dumps(

@@ -185,6 +185,11 @@ def main() -> int:
                    "cdc_fraction": args.cdc_fraction,
                    "ingress_fraction": args.ingress_fraction,
                    "fixed": args.fixed, "ok": err is None}
+            if args.trace:
+                # the hub replay re-records the stitched cluster trace
+                # at the same path, so a confirmed failure ships with a
+                # diffable trace artifact
+                rec["trace"] = f"{args.trace}.{seed}.json"
             rec["error" if err else "stats"] = err or stats
             sink.write(json.dumps(rec) + "\n")
             sink.flush()

@@ -81,6 +81,14 @@ def replay(rec: dict) -> tuple[bool, str | None]:
         ingress_fraction=rec.get(
             "ingress_fraction", INGRESS_FRACTION_DEFAULT
         ),
+        # a fleet run with --trace recorded the stitched cluster trace
+        # per seed: the replay dumps its own at a SIBLING path (failing
+        # seeds dump in the simulator's finally) — never the fleet's
+        # path, which is exactly the artifact a diverging replay must
+        # still be diffable against
+        trace_path=(
+            f"{rec['trace']}.replay.json" if rec.get("trace") else None
+        ),
     )
     return err is not None, err
 

@@ -1,0 +1,350 @@
+"""`tigerbeetle inspect`: offline data-file + live-state introspection.
+
+Tier-1 smoke contract (reference: src/tigerbeetle/inspect.zig): a freshly
+formatted and briefly-driven data file decodes offline — superblock
+copies with checksum verdicts, WAL ring slots (incl. a deliberately torn
+tail, diagnosed with the slot class and the exact break op), client-reply
+slots, the client table, checkpoint blobs — and a RUNNING server answers
+`inspect live` with its [stats] registry snapshot over the wire.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+import tests.conftest  # noqa: F401 — CPU platform before jax init
+from tigerbeetle_tpu import types
+from tigerbeetle_tpu.constants import TEST_PROCESS, ConfigCluster
+from tigerbeetle_tpu.io.network import InProcessNetwork
+from tigerbeetle_tpu.io.storage import FileStorage, Zone, ZoneLayout
+from tigerbeetle_tpu.io.time import DeterministicTime
+from tigerbeetle_tpu.types import Operation
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _drive_data_file(path: str) -> tuple[ConfigCluster, int]:
+    """Format + drive a single-replica (oracle backend) over a real
+    FileStorage: register, accounts, transfers, a checkpoint, and one
+    post-checkpoint op so the WAL carries a replayable tail. Returns
+    (cluster config, head op)."""
+    from tigerbeetle_tpu.cli import main
+    from tigerbeetle_tpu.models.oracle import OracleStateMachine
+    from tigerbeetle_tpu.vsr.client import Client
+    from tigerbeetle_tpu.vsr.replica import Replica
+
+    assert main(["format", "--cluster", "0", "--replica", "0",
+                 "--replica-count", "1", path]) == 0
+    cluster = ConfigCluster()
+    layout = ZoneLayout(cluster, grid_size=64 * 1024 * 1024)
+    storage = FileStorage(path, layout, create=False)
+    net = InProcessNetwork()
+    r = Replica(0, 1, storage, net, DeterministicTime(), cluster,
+                TEST_PROCESS, backend_factory=OracleStateMachine)
+    r.sync_payload_async = False
+    r.open()
+    c = Client(1 << 64, net, 1)
+    c.register()
+    net.run()
+    c.take_reply()
+
+    def execute(op, body):
+        c.request(op, body)
+        net.run()
+        return c.take_reply()
+
+    acct = np.zeros(2, dtype=types.ACCOUNT_DTYPE)
+    acct["id_lo"] = [1, 2]
+    acct["ledger"] = 1
+    acct["code"] = 1
+    execute(Operation.create_accounts, acct.tobytes())
+    for i in range(3):
+        t = np.zeros(1, dtype=types.TRANSFER_DTYPE)
+        t["id_lo"] = 100 + i
+        t["debit_account_id_lo"] = 1
+        t["credit_account_id_lo"] = 2
+        t["amount_lo"] = 1
+        t["ledger"] = 1
+        t["code"] = 1
+        execute(Operation.create_transfers, t.tobytes())
+    r.checkpoint()
+    t = np.zeros(1, dtype=types.TRANSFER_DTYPE)
+    t["id_lo"] = 999
+    t["debit_account_id_lo"] = 1
+    t["credit_account_id_lo"] = 2
+    t["amount_lo"] = 1
+    t["ledger"] = 1
+    t["code"] = 1
+    execute(Operation.create_transfers, t.tobytes())
+    head = r.op
+    storage.close()
+    return cluster, head
+
+
+def test_inspect_offline_decodes_a_driven_data_file(tmp_path, capsys):
+    """The tier-1 smoke: every offline topic decodes a real formatted +
+    driven file, and the reports carry the facts an operator would act
+    on (quorum verdicts, replayable chain, sessions, blob checksums)."""
+    from tigerbeetle_tpu import inspect as _inspect
+    from tigerbeetle_tpu.cli import main
+
+    path = str(tmp_path / "data.tb")
+    cluster, head = _drive_data_file(path)
+
+    storage = _inspect.open_storage(path, cluster)
+    try:
+        sb = _inspect.inspect_superblock(storage)
+        assert sb["quorum"] is not None
+        assert sb["quorum_copies"] == 4
+        assert all(c["verdict"] == "valid" for c in sb["copies"])
+        state = sb["state"]
+        assert state.commit_min == head - 1  # checkpoint preceded last op
+
+        wal = _inspect.inspect_wal(storage, cluster, state)
+        assert wal["stats"]["valid"] == head  # every op journaled intact
+        assert wal["chain_end"] == head
+        assert wal["chain_break"] is None
+
+        one = _inspect.inspect_wal_op(storage, cluster, head)
+        assert one["verdict"] == "valid"
+        assert one["header"]["operation"] == "create_transfers"
+        assert one["body"]["events"] == 1
+        assert int(one["trace"], 16) != 0  # the op's causal trace id
+
+        replies = _inspect.inspect_replies(storage, cluster)
+        assert len(replies["slots"]) == 1
+        assert replies["slots"][0]["body_ok"] is True
+
+        table = _inspect.inspect_client_table(storage, state)
+        assert table["sessions"] == 1
+        assert table["source"] == "inline"
+
+        grid = _inspect.inspect_grid(storage, cluster, state)
+        assert all(b["checksum_ok"] for b in grid["blobs"])
+    finally:
+        storage.close()
+
+    # the CLI wiring end to end (text + --json)
+    assert main(["inspect", "all", path]) == 0
+    out = capsys.readouterr().out
+    assert "quorum: sequence" in out
+    assert "replayable chain" in out
+    assert main(["inspect", "superblock", "--json", path]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["quorum"] is not None
+
+
+def test_inspect_diagnoses_a_torn_wal_tail(tmp_path, capsys):
+    """Tear the head op's prepare body (a crash mid-write): the WAL scan
+    classifies the slot, and the chain diagnosis names the exact op and
+    why — the `inspect` cookbook's first recipe."""
+    from tigerbeetle_tpu import inspect as _inspect
+    from tigerbeetle_tpu.cli import main
+
+    path = str(tmp_path / "data.tb")
+    cluster, head = _drive_data_file(path)
+
+    layout = ZoneLayout(cluster, grid_size=64 * 1024 * 1024)
+    storage = FileStorage(path, layout, create=False)
+    slot = head % cluster.journal_slot_count
+    raw = bytearray(storage.read(
+        Zone.wal_prepares, slot * cluster.message_size_max, 4096
+    ))
+    for i in range(200, 264):
+        raw[i] ^= 0xFF
+    storage.write(Zone.wal_prepares, slot * cluster.message_size_max,
+                  bytes(raw))
+    storage.close()
+
+    storage = _inspect.open_storage(path, cluster)
+    try:
+        state = _inspect.inspect_superblock(storage)["state"]
+        wal = _inspect.inspect_wal(storage, cluster, state)
+        assert wal["chain_end"] == head - 1
+        assert wal["chain_break"] == {
+            "op": head, "slot": slot, "why": "torn_prepare",
+        }
+        one = _inspect.inspect_wal_op(storage, cluster, head)
+        assert one["verdict"] == "body checksum mismatch (torn)"
+    finally:
+        storage.close()
+
+    assert main(["inspect", "wal", path]) == 0
+    out = capsys.readouterr().out
+    assert f"TORN TAIL: chain breaks at op {head}" in out
+
+
+def test_inspect_diagnoses_a_misdirected_wal_write(tmp_path):
+    """A checksum-VALID prepare that landed in the WRONG slot must not
+    make the chain walk call the log replayable: recovery reads the
+    op's own slot (stale/blank) and stops there — inspect must say so,
+    and name the stray copy."""
+    from tigerbeetle_tpu import inspect as _inspect
+
+    path = str(tmp_path / "data.tb")
+    cluster, head = _drive_data_file(path)
+
+    layout = ZoneLayout(cluster, grid_size=64 * 1024 * 1024)
+    storage = FileStorage(path, layout, create=False)
+    msg_max = cluster.message_size_max
+    slot = head % cluster.journal_slot_count
+    wrong = (slot + 7) % cluster.journal_slot_count
+    raw = storage.read(Zone.wal_prepares, slot * msg_max, msg_max)
+    storage.write(Zone.wal_prepares, wrong * msg_max, raw)  # stray copy
+    # the op's own slot loses its prepare AND its redundant header (the
+    # misdirected-write shape: nothing landed where it should have);
+    # header zeroing is a sector-aligned read-modify-write (O_DIRECT)
+    storage.write(Zone.wal_prepares, slot * msg_max, b"\0" * 4096)
+    hsec = slot * 128 // 4096 * 4096
+    sector = bytearray(storage.read(Zone.wal_headers, hsec, 4096))
+    off = slot * 128 - hsec
+    sector[off : off + 128] = b"\0" * 128
+    storage.write(Zone.wal_headers, hsec, bytes(sector))
+    storage.close()
+
+    storage = _inspect.open_storage(path, cluster)
+    try:
+        state = _inspect.inspect_superblock(storage)["state"]
+        wal = _inspect.inspect_wal(storage, cluster, state)
+        assert wal["stats"].get("misdirected") == 1
+        assert wal["chain_end"] == head - 1  # NOT "replayable to head"
+        assert wal["chain_break"] == {
+            "op": head, "slot": wrong,
+            "why": "misdirected (found in wrong slot)",
+        }
+    finally:
+        storage.close()
+
+
+def test_inspect_lsm_decodes_manifest_per_groove(tmp_path):
+    """A checkpointed LSM forest's manifest decodes offline: tables per
+    tree/level with entry counts and key ranges, named per groove."""
+    from tigerbeetle_tpu import inspect as _inspect
+    from tigerbeetle_tpu.lsm.grid import Grid
+    from tigerbeetle_tpu.lsm.groove import Forest
+    from tigerbeetle_tpu.vsr.superblock import SuperBlock, VSRState
+
+    cluster = ConfigCluster()
+    layout = ZoneLayout(cluster, grid_size=64 * 1024 * 1024,
+                        forest_blocks=192)
+    path = str(tmp_path / "lsm.tb")
+    storage = FileStorage(path, layout, create=True)
+    try:
+        forest = Forest(Grid(
+            storage, offset=layout.forest_offset, block_count=192,
+        ), memtable_max=8)
+        for ts in range(1, 33):  # spans several flushed tables
+            forest.posted.put(ts.to_bytes(8, "big"), b"\x01")
+        meta = {
+            "manifest": forest.checkpoint(),
+            "spilled_blocks": [],
+            "spilled_count": 0,
+        }
+        sb = SuperBlock(storage)
+        sb.checkpoint(VSRState(sequence=1, meta={"spill": meta}))
+
+        state = _inspect.inspect_superblock(storage)["state"]
+        lsm = _inspect.inspect_lsm(storage, cluster, state)
+        assert lsm["manifest_events"] > 0
+        posted = next(
+            t for t in lsm["trees"] if t["name"] == "posted"
+        )
+        total = sum(lv["entries"] for lv in posted["levels"])
+        assert total == 32
+        grid_rep = _inspect.inspect_grid(storage, cluster, state)
+        fs = grid_rep["free_set"]
+        assert fs["acquired"] > 0 and fs["corrupt"] == []
+    finally:
+        storage.close()
+
+
+def _spawn_server(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO, TB_JAX_PLATFORM="cpu",
+               TB_PARENT_WATCHDOG="1")
+    path = str(tmp_path / "live.tb")
+    fmt = subprocess.run(
+        [sys.executable, "-m", "tigerbeetle_tpu", "format",
+         "--cluster", "0", "--replica", "0", "--replica-count", "1",
+         path],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert fmt.returncode == 0, fmt.stderr
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tigerbeetle_tpu", "start",
+         "--addresses", f"127.0.0.1:{port}",
+         "--backend", "native",
+         "--account-slots-log2", "14", "--transfer-slots-log2", "14",
+         path],
+        cwd=REPO, env=env, start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    while True:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError("server died before listening")
+        if "listening" in line:
+            return proc, port
+
+
+def test_inspect_live_reads_running_server_stats(tmp_path):
+    """`inspect live` pulls the [stats] registry snapshot off a running
+    server socket; SIGQUIT dumps hang diagnosis WITHOUT killing the
+    server; and the [stats] line at SIGTERM agrees with the wire
+    snapshot's registry (same store)."""
+    from tigerbeetle_tpu.inspect import inspect_live
+    from tigerbeetle_tpu.metrics import CATALOG
+
+    proc, port = _spawn_server(tmp_path)
+    try:
+        snap = inspect_live("127.0.0.1", port)
+        assert snap["status"] == "normal"
+        assert snap["replica"] == 0
+        counters = snap["metrics"]["counters"]
+        assert counters["inspect.live_requests"] == 1
+        # hang diagnosis: SIGQUIT dumps and the server keeps serving
+        os.kill(proc.pid, signal.SIGQUIT)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap2 = inspect_live("127.0.0.1", port)
+            if snap2["metrics"]["counters"].get("trace.sigquit_dumps"):
+                break
+            time.sleep(0.1)
+        assert proc.poll() is None, "SIGQUIT must not kill the server"
+        assert snap2["metrics"]["counters"]["trace.sigquit_dumps"] == 1
+        # metric-catalog drift guard, against a REAL server snapshot:
+        # every counter/gauge the server emits must be CATALOG'd
+        # (tests/test_metrics.py enforces the same for each subsystem's
+        # names; this is the end-to-end [stats] surface)
+        emitted = set(snap2["metrics"]["counters"]) | set(
+            snap2["metrics"]["gauges"]
+        )
+        missing = emitted - set(CATALOG)
+        assert not missing, f"[stats] names missing from CATALOG: {missing}"
+    finally:
+        proc.terminate()
+        out, _ = proc.communicate(timeout=60)
+    # the SIGTERM [stats] line reads the same registry
+    stats_line = next(
+        line for line in out.splitlines() if line.startswith("[stats] ")
+    )
+    stats = json.loads(stats_line[8:])
+    assert stats["metrics"]["counters"]["trace.sigquit_dumps"] == 1
+    emitted = set(stats["metrics"]["counters"]) | set(
+        stats["metrics"]["gauges"]
+    )
+    from tigerbeetle_tpu.metrics import CATALOG
+
+    assert not emitted - set(CATALOG)
+    # the SIGQUIT diagnosis reached stderr/stdout
+    assert "[quit] status=" in out
+    assert "Current thread" in out  # faulthandler stack snapshot

@@ -203,11 +203,15 @@ def _assert_chrome_trace_schema(events):
     assert isinstance(events, list) and events
     for e in events:
         assert isinstance(e["name"], str) and e["name"]
-        assert e["ph"] in ("X", "B")
+        # X/B: span events; s/t/f: generated flow events (stitched
+        # cluster traces); M: process_name metadata
+        assert e["ph"] in ("X", "B", "s", "t", "f", "M")
         assert isinstance(e["ts"], (int, float))
         assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
         if e["ph"] == "X":
             assert e["dur"] >= 0
+        if e["ph"] in ("s", "t", "f"):
+            assert isinstance(e["id"], str) and e["id"]
 
 
 def test_trace_schema_from_real_pipeline(tmp_path):
@@ -259,6 +263,28 @@ def test_trace_schema_from_real_pipeline(tmp_path):
     assert snap["histograms"]["replica.commit_dispatch_us"]["count"] >= 4
     # span durations fed histograms through the tracer's metrics hookup
     assert snap["histograms"]["span.replica.commit_dispatch"]["count"] >= 4
+    # name-coverage drift guard: every counter/gauge a real commit
+    # pipeline registers must be CATALOG'd (the end-to-end [stats]
+    # surface gets the same check against a spawned server in
+    # tests/test_inspect.py)
+    from tigerbeetle_tpu.metrics import CATALOG
+
+    emitted = set(snap["counters"]) | set(snap["gauges"])
+    missing = emitted - set(CATALOG)
+    assert not missing, f"registry names missing from CATALOG: {missing}"
+
+
+def test_trace_and_inspect_metric_names_cataloged():
+    """The observability layer's own names follow the same contract
+    every subsystem's names do (the cdc.*/ingress.* checks below):
+    present in CATALOG with a kind, unit and help string."""
+    from tigerbeetle_tpu.metrics import CATALOG
+
+    for name in ("trace.sigquit_dumps", "inspect.live_requests"):
+        assert name in CATALOG, name
+        kind, unit, help_ = CATALOG[name]
+        assert kind == "counter"
+        assert help_
 
 
 # -- deterministic simulator tracer ------------------------------------
@@ -273,8 +299,9 @@ def _histories_digest(sim) -> str:
 
 
 def test_sim_tracer_reproducible_and_pure(tmp_path):
-    """Same VOPR seed twice -> byte-identical trace dumps (tick-based
-    timestamps, canonical JSON); enabling tracing leaves the committed
+    """Same VOPR seed twice -> byte-identical STITCHED trace dumps
+    (tick-based timestamps, one pid per replica, canonical JSON incl.
+    the generated flow events); enabling tracing leaves the committed
     history unchanged vs an untraced run of the same seed."""
     from tigerbeetle_tpu.testing.simulator import Simulator
 
@@ -291,6 +318,12 @@ def test_sim_tracer_reproducible_and_pure(tmp_path):
     # tick timestamps, not wall time: every ts is a whole tick count far
     # below any perf_counter_ns value
     assert all(e["ts"] == int(e["ts"]) for e in events)
+    # the stitched cluster trace spans multiple replica pids and carries
+    # cross-pid flow events linking each op's legs
+    span_pids = {e["pid"] for e in events if e["ph"] in ("X", "B")}
+    assert len(span_pids) >= 2, span_pids
+    flow_ids = {e["id"] for e in events if e["ph"] in ("s", "t", "f")}
+    assert flow_ids, "no op flows in the stitched sim trace"
     s3 = Simulator(4242, ticks=300)  # tracing off
     s3.run()
     assert _histories_digest(s1) == _histories_digest(s3)

@@ -66,27 +66,33 @@ def test_replay_passes_recorded_verify_fraction(monkeypatch):
 
     def fake_run_seed(seed, ticks, device_fraction=0.0, fixed=False,
                       verify_fraction=None, cdc_fraction=None,
-                      ingress_fraction=None):
+                      ingress_fraction=None, trace_path=None):
         seen.update(seed=seed, verify_fraction=verify_fraction,
                     cdc_fraction=cdc_fraction,
-                    ingress_fraction=ingress_fraction)
+                    ingress_fraction=ingress_fraction,
+                    trace_path=trace_path)
         return None, "r3", None
 
     monkeypatch.setattr(vopr_mod, "run_seed", fake_run_seed)
     rec = {"seed": 7, "ticks": 50, "topology": "r3 c2",
            "verify_fraction": 0.6, "cdc_fraction": 0.5,
-           "ingress_fraction": 0.4,
+           "ingress_fraction": 0.4, "trace": "/tmp/t.7.json",
            "ok": False, "error": "X"}
     replay(rec)
     assert seen["verify_fraction"] == 0.6
     assert seen["cdc_fraction"] == 0.5
     assert seen["ingress_fraction"] == 0.4
+    # a fleet run with --trace recorded the per-seed stitched trace
+    # path: the replay dumps at a SIBLING path so a diverging replay
+    # stays diffable against the fleet's original artifact
+    assert seen["trace_path"] == "/tmp/t.7.json.replay.json"
     # legacy record (pre-field): the defaults apply
     replay({"seed": 8, "ticks": 50, "topology": "r3 c2",
             "ok": False, "error": "X"})
     assert seen["verify_fraction"] == vopr_mod.VERIFY_FRACTION_DEFAULT
     assert seen["cdc_fraction"] == vopr_mod.CDC_FRACTION_DEFAULT
     assert seen["ingress_fraction"] == vopr_mod.INGRESS_FRACTION_DEFAULT
+    assert seen["trace_path"] is None
 
 
 def test_hub_clean_fleet_exits_zero(tmp_path):

@@ -1,0 +1,706 @@
+"""`tigerbeetle inspect` — offline data-file and live-state introspection.
+
+The reference ships `tigerbeetle inspect` (reference:
+src/tigerbeetle/inspect.zig): when something is wrong ON DISK, the
+operator decodes the data file directly — superblock copies with checksum
+verdicts, WAL ring slots with torn-write diagnosis, client-reply slots,
+the grid free set, and the LSM manifest — without starting (or being able
+to start) a replica. This is that tool over our zones
+(io/storage.py: superblock | wal_headers | wal_prepares | client_replies
+| grid), plus a LIVE mode that asks a running replica for its
+[stats]-registry snapshot over the wire (Command.request_stats).
+
+Every decoder is a pure read: nothing here ever writes to the data file,
+so inspecting a corrupt file cannot make it worse. Reports are plain
+dicts (the CLI renders them as text or `--json`), so tests assert on the
+same structures operators read.
+
+Geometry: the fixed zones (superblock, WAL rings, client replies) derive
+from the cluster config the file was formatted with; the grid zone is
+whatever remains of the file, so only non-default `--clients-max` /
+`--client-reply-slots` need to be repeated (the same contract as
+`start`). The config fingerprint in the superblock meta cross-checks the
+guess.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tigerbeetle_tpu.constants import ConfigCluster
+from tigerbeetle_tpu.io.storage import SECTOR_SIZE, Storage, Zone, ZoneLayout
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
+from tigerbeetle_tpu.vsr.superblock import SuperBlock, VSRState
+
+# operation u8 -> display name (unknown values print as the raw byte)
+_OP_NAMES = {int(op): op.name for op in Operation}
+_EVENT_OPS = (
+    int(Operation.create_accounts), int(Operation.create_transfers)
+)
+
+
+def open_storage(path: str, cluster: ConfigCluster,
+                 forest_blocks: int = 0):
+    """Open a data file for inspection, inferring the grid-zone size from
+    the file size (the fixed zones are determined by the cluster config;
+    the grid is the remainder)."""
+    import os
+
+    from tigerbeetle_tpu.io.storage import FileStorage
+
+    probe = ZoneLayout(cluster, grid_size=1 << 20)
+    fixed = probe.total_size - probe.sizes[Zone.grid]
+    file_size = os.path.getsize(path)
+    grid_size = file_size - fixed
+    if grid_size <= 0:
+        raise RuntimeError(
+            f"{path}: {file_size} bytes is smaller than the fixed zones "
+            f"({fixed} bytes) for this cluster config — wrong "
+            "--clients-max/--client-reply-slots?"
+        )
+    layout = ZoneLayout(cluster, grid_size=grid_size,
+                        forest_blocks=forest_blocks)
+    return FileStorage(path, layout, create=False)
+
+
+# ----------------------------------------------------------------------
+# superblock
+# ----------------------------------------------------------------------
+
+
+def inspect_superblock(storage: Storage) -> dict:
+    """Decode all redundant superblock copies independently (the quorum
+    open would hide a corrupt copy; the operator wants per-copy
+    verdicts), then report the quorum winner."""
+    copies = []
+    decoded: list[VSRState | None] = []
+    for copy in range(ZoneLayout.SUPERBLOCK_COPIES):
+        raw = storage.read(
+            Zone.superblock,
+            copy * ZoneLayout.SUPERBLOCK_COPY_SIZE,
+            ZoneLayout.SUPERBLOCK_COPY_SIZE,
+        )
+        st, verdict = SuperBlock.decode_copy(raw)
+        decoded.append(st)
+        rec: dict = {
+            "copy": copy,
+            "magic_ok": verdict != "bad magic",
+            "checksum_ok": verdict == "valid",
+            "verdict": verdict,
+        }
+        copies.append(rec)
+        if st is None:
+            continue
+        rec.update(
+            cluster=st.cluster, replica=st.replica, sequence=st.sequence,
+            commit_min=st.commit_min,
+            commit_min_checksum=f"{st.commit_min_checksum:x}",
+            view=int(st.meta.get("view", 0)),
+            log_view=int(st.meta.get("log_view", 0)),
+            area=st.area,
+            blobs=[
+                {"name": b.name, "offset": b.offset, "size": b.size,
+                 "checksum": f"{b.checksum:x}"}
+                for b in st.blobs
+            ],
+        )
+    # the SAME quorum rule the replica opens with (SuperBlock owns it)
+    state, n_copies = SuperBlock.quorum_winner(decoded)
+    return {
+        "copies": copies,
+        "quorum": state.sequence if state is not None else None,
+        "quorum_copies": n_copies,
+        "state": state,
+    }
+
+
+def _open_state(storage: Storage) -> VSRState | None:
+    return inspect_superblock(storage)["state"]
+
+
+# ----------------------------------------------------------------------
+# WAL rings
+# ----------------------------------------------------------------------
+
+
+def _classify_slot(slot: int, praw: bytes, rraw: bytes,
+                   cluster: ConfigCluster) -> dict:
+    """One WAL slot's evidence from BOTH rings — the same decision matrix
+    as Journal.recover (reference: src/vsr/journal.zig:374-535), but
+    reported instead of acted on."""
+    slot_count = cluster.journal_slot_count
+    p_header = Header.from_bytes(praw[:HEADER_SIZE])
+    p_checksum_ok = (
+        p_header.valid_checksum() and p_header.command == Command.prepare
+        and p_header.size <= cluster.message_size_max
+    )
+    p_body_ok = p_checksum_ok and p_header.valid_checksum_body(
+        praw[HEADER_SIZE : p_header.size]
+    )
+    p_here = p_body_ok and p_header.op % slot_count == slot
+    r_header = Header.from_bytes(rraw)
+    r_ok = (
+        r_header.valid_checksum() and r_header.command == Command.prepare
+        and r_header.op % slot_count == slot
+    )
+    rec: dict = {"slot": slot}
+    if p_checksum_ok:
+        rec["prepare"] = {
+            "op": p_header.op, "size": p_header.size,
+            "operation": _OP_NAMES.get(p_header.operation,
+                                       p_header.operation),
+            "checksum": f"{p_header.checksum:x}",
+            "parent": f"{p_header.parent:x}",
+            "header_ok": True, "body_ok": p_body_ok,
+        }
+    if r_ok:
+        rec["redundant"] = {
+            "op": r_header.op, "checksum": f"{r_header.checksum:x}",
+        }
+    if p_body_ok and not p_here:
+        rec["class"] = "misdirected"  # valid prepare, wrong slot
+    elif p_here and (not r_ok or r_header.op <= p_header.op):
+        rec["class"] = (
+            "valid" if r_ok and r_header.op == p_header.op
+            else "torn_header"
+        )
+    elif r_ok:
+        # redundant header is the newest evidence; the body is lost
+        rec["class"] = "wrap_stale" if p_here else (
+            "torn_prepare" if p_checksum_ok or any(praw[:HEADER_SIZE])
+            else "faulty"
+        )
+        rec["lost_op"] = r_header.op
+    elif p_checksum_ok and not p_body_ok:
+        rec["class"] = "torn_prepare"  # header landed, body torn, no mirror
+    else:
+        rec["class"] = "blank"
+    return rec
+
+
+def inspect_wal(storage: Storage, cluster: ConfigCluster,
+                state: VSRState | None = None) -> dict:
+    """Scan both WAL rings slot by slot; classify each and diagnose the
+    replayable tail: starting at the checkpoint (superblock commit_min),
+    walk the hash chain op by op and report where — and WHY — it ends
+    (the torn-tail diagnosis: chain_end + chain_break)."""
+    if state is None:
+        state = _open_state(storage)
+    msg_max = cluster.message_size_max
+    raw_headers = storage.read(
+        Zone.wal_headers, 0,
+        (cluster.journal_slot_count * HEADER_SIZE + SECTOR_SIZE - 1)
+        // SECTOR_SIZE * SECTOR_SIZE,
+    )
+    slots = []
+    stats: dict[str, int] = {}
+    by_op: dict[int, dict] = {}
+    for slot in range(cluster.journal_slot_count):
+        praw = storage.read(Zone.wal_prepares, slot * msg_max, msg_max)
+        rec = _classify_slot(
+            slot, praw,
+            raw_headers[slot * HEADER_SIZE : (slot + 1) * HEADER_SIZE],
+            cluster,
+        )
+        stats[rec["class"]] = stats.get(rec["class"], 0) + 1
+        if rec["class"] != "blank":
+            slots.append(rec)
+        p = rec.get("prepare")
+        # only prepares sitting in THEIR OWN slot are replay evidence: a
+        # misdirected write's body is intact but recovery reads slot
+        # op % slot_count, which holds something else — indexing it here
+        # would make the chain walk call a torn log "replayable"
+        if (
+            p is not None and p["body_ok"]
+            and rec["class"] in ("valid", "torn_header")
+        ):
+            by_op[p["op"]] = p
+    report: dict = {"slots": slots, "stats": stats}
+    if state is not None:
+        # torn-tail diagnosis: walk the hash chain from the checkpoint;
+        # where it stops, say WHY — a torn/faulty/misdirected slot naming
+        # this op is damage, anything else is just the end of the log
+        by_slot = {s["slot"]: s for s in slots}
+        chain = state.commit_min_checksum
+        op = state.commit_min + 1
+        report["checkpoint_op"] = state.commit_min
+        report["chain_break"] = None
+        while True:
+            p = by_op.get(op)
+            if p is None:
+                s = by_slot.get(op % cluster.journal_slot_count)
+                damaged = s is not None and (
+                    s.get("lost_op") == op
+                    or s["class"] == "misdirected"
+                    or (
+                        s.get("prepare", {}).get("op") == op
+                        and not s["prepare"]["body_ok"]
+                    )
+                )
+                if damaged:
+                    report["chain_break"] = {
+                        "op": op, "slot": s["slot"], "why": s["class"],
+                    }
+                else:
+                    # the op's own slot says nothing, but a MISDIRECTED
+                    # copy of it elsewhere proves the op existed and its
+                    # write landed in the wrong place — that is damage,
+                    # not the end of the log
+                    stray = next(
+                        (x for x in slots
+                         if x["class"] == "misdirected"
+                         and x.get("prepare", {}).get("op") == op),
+                        None,
+                    )
+                    if stray is not None:
+                        report["chain_break"] = {
+                            "op": op, "slot": stray["slot"],
+                            "why": "misdirected (found in wrong slot)",
+                        }
+                break
+            if int(p["parent"], 16) != chain:
+                report["chain_break"] = {
+                    "op": op, "slot": op % cluster.journal_slot_count,
+                    "why": "parent checksum mismatch (stale timeline)",
+                }
+                break
+            chain = int(p["checksum"], 16)
+            op += 1
+        report["chain_end"] = op - 1
+    return report
+
+
+def inspect_wal_op(storage: Storage, cluster: ConfigCluster,
+                   op: int) -> dict:
+    """Dump ONE prepare from the WAL ring: full header fields, checksum
+    verdicts, and a body summary (event count + first/last ids for the
+    create ops)."""
+    msg_max = cluster.message_size_max
+    slot = op % cluster.journal_slot_count
+    praw = storage.read(Zone.wal_prepares, slot * msg_max, msg_max)
+    header = Header.from_bytes(praw[:HEADER_SIZE])
+    rec: dict = {"op": op, "slot": slot}
+    if not header.valid_checksum():
+        rec["verdict"] = "slot header fails its checksum"
+        return rec
+    if header.op != op:
+        rec["verdict"] = f"slot holds op {header.op} (ring wrapped)"
+        rec["slot_op"] = header.op
+        return rec
+    body = praw[HEADER_SIZE : header.size]
+    body_ok = header.valid_checksum_body(body)
+    rec.update(
+        verdict="valid" if body_ok else "body checksum mismatch (torn)",
+        header={
+            "checksum": f"{header.checksum:x}",
+            "checksum_body": f"{header.checksum_body:x}",
+            "parent": f"{header.parent:x}",
+            "client": f"{header.client:x}",
+            "context": f"{header.context:x}",
+            "request": header.request,
+            "cluster": header.cluster,
+            "view": header.view,
+            "op": header.op,
+            "commit": header.commit,
+            "timestamp": header.timestamp,
+            "size": header.size,
+            "replica": header.replica,
+            "operation": _OP_NAMES.get(header.operation, header.operation),
+        },
+        trace=f"{header.trace():x}",  # the op's cluster-causal trace id
+    )
+    if header.operation in _EVENT_OPS and body_ok and len(body) >= 128:
+        events = len(body) // 128
+        first_id = int.from_bytes(body[0:16], "little")
+        last = body[(events - 1) * 128 :]
+        rec["body"] = {
+            "events": events,
+            "first_id": f"{first_id:x}",
+            "last_id": f"{int.from_bytes(last[0:16], 'little'):x}",
+        }
+    return rec
+
+
+# ----------------------------------------------------------------------
+# client replies + client table
+# ----------------------------------------------------------------------
+
+
+def inspect_replies(storage: Storage, cluster: ConfigCluster) -> dict:
+    """Decode every client-reply slot (reference: client_replies.zig):
+    a valid slot holds the wire reply (header + body) last persisted for
+    the session that owns it."""
+    msg_max = cluster.message_size_max
+    slots = []
+    for slot in range(cluster.reply_slot_count):
+        raw = storage.read(Zone.client_replies, slot * msg_max, msg_max)
+        header = Header.from_bytes(raw[:HEADER_SIZE])
+        if not (
+            header.valid_checksum()
+            and header.command == int(Command.reply)
+            and header.size <= msg_max
+        ):
+            continue
+        body_ok = header.valid_checksum_body(
+            raw[HEADER_SIZE : header.size]
+        )
+        slots.append({
+            "slot": slot,
+            "client": f"{header.client:x}",
+            "request": header.request,
+            "op": header.op,
+            "size": header.size,
+            "operation": _OP_NAMES.get(header.operation, header.operation),
+            "checksum": f"{header.checksum:x}",
+            "body_ok": body_ok,
+        })
+    return {"slot_count": cluster.reply_slot_count, "slots": slots}
+
+
+def inspect_client_table(storage: Storage,
+                         state: VSRState | None = None) -> dict:
+    """The checkpointed client table: inline in the superblock meta, or
+    (many-session ingress mode) spilled to its grid blob — decoded with
+    the blob's checksum verdict."""
+    from tigerbeetle_tpu import native
+
+    if state is None:
+        state = _open_state(storage)
+    if state is None:
+        return {"error": "no superblock quorum"}
+    rec: dict = {"source": "inline"}
+    table = state.meta.get("client_table")
+    if state.meta.get("client_table_blob"):
+        rec["source"] = "grid blob"
+        ref = next(
+            (b for b in state.blobs if b.name == "client_table"), None
+        )
+        if ref is None:
+            return dict(rec, error="blob flagged but not referenced")
+        raw = storage.read(Zone.grid, ref.offset, ref.size)
+        rec["checksum_ok"] = native.checksum(raw) == ref.checksum
+        if not rec["checksum_ok"]:
+            return dict(rec, error="blob checksum mismatch")
+        table = json.loads(raw.decode())
+    if table is None:
+        return dict(rec, sessions=0, entries=[])
+    entries = [
+        {
+            "client": f"{int(c):x}",
+            "session": e["session"],
+            "request": e["request"],
+            "slot": e.get("slot"),
+            "reply_checksum": e.get("reply_checksum", "0"),
+        }
+        for c, e in sorted(table.items(), key=lambda kv: int(kv[0]))
+    ]
+    return dict(rec, sessions=len(entries), entries=entries)
+
+
+# ----------------------------------------------------------------------
+# grid + LSM forest
+# ----------------------------------------------------------------------
+
+
+def inspect_grid(storage: Storage, cluster: ConfigCluster,
+                 state: VSRState | None = None) -> dict:
+    """The grid zone: checkpoint blob references (with checksum
+    verdicts), the two ping-pong snapshot areas, and — when the file
+    carries an LSM forest — the free set plus a verify scan over every
+    acquired block."""
+    from tigerbeetle_tpu import native
+
+    if state is None:
+        state = _open_state(storage)
+    layout = storage.layout
+    rec: dict = {
+        "snapshot_area_size": layout.snapshot_area_size,
+        "forest_offset": layout.forest_offset,
+        "forest_blocks": layout.forest_blocks,
+    }
+    if state is None:
+        return dict(rec, error="no superblock quorum")
+    rec["area"] = state.area
+    rec["blobs"] = [
+        {
+            "name": b.name, "offset": b.offset, "size": b.size,
+            "checksum_ok": native.checksum(
+                storage.read(Zone.grid, b.offset, b.size)
+            ) == b.checksum,
+        }
+        for b in state.blobs
+    ]
+    spill = state.meta.get("spill")
+    if spill and layout.forest_blocks:
+        from tigerbeetle_tpu.lsm.grid import BLOCK_SIZE, Grid
+        from tigerbeetle_tpu.vsr.free_set import FreeSet
+
+        free_set = FreeSet.decode(
+            bytes.fromhex(spill["manifest"]["free_set"]),
+            layout.forest_blocks,
+        )
+        acquired = [
+            a for a in range(1, layout.forest_blocks + 1)
+            if not free_set.is_free(a)
+        ]
+        corrupt = [
+            a for a in acquired
+            if Grid.validate_raw(storage.read(
+                Zone.grid, layout.forest_offset + (a - 1) * BLOCK_SIZE,
+                BLOCK_SIZE,
+            )) is None
+        ]
+        rec["free_set"] = {
+            "blocks": layout.forest_blocks,
+            "free": free_set.count_free(),
+            "acquired": len(acquired),
+            "corrupt": corrupt,
+        }
+        rec["spilled_count"] = spill.get("spilled_count", 0)
+        rec["spilled_blocks"] = spill.get("spilled_blocks", [])
+    elif spill:
+        rec["note"] = (
+            "checkpoint carries spill meta but no --forest-blocks was "
+            "given: pass the forest geometry to decode the free set"
+        )
+    return rec
+
+
+# groove display names by tree id (lsm/groove.py tree_ids, which mirror
+# reference src/state_machine.zig:67-100)
+def _tree_names() -> dict[int, str]:
+    from tigerbeetle_tpu.lsm.groove import (
+        ACCOUNT_TREE_IDS,
+        POSTED_TREE_ID,
+        TRANSFER_TREE_IDS,
+    )
+
+    names = {}
+    for field, tid in ACCOUNT_TREE_IDS.items():
+        names[tid] = f"accounts.{field}"
+    for field, tid in TRANSFER_TREE_IDS.items():
+        names[tid] = f"transfers.{field}"
+    names[POSTED_TREE_ID] = "posted"
+    return names
+
+
+def inspect_lsm(storage: Storage, cluster: ConfigCluster,
+                state: VSRState | None = None) -> dict:
+    """LSM manifest/table summaries per groove: replay the manifest-log
+    block chain (lsm/manifest_log.py) and report, per tree and level,
+    the live tables with entry counts and key ranges."""
+    if state is None:
+        state = _open_state(storage)
+    if state is None:
+        return {"error": "no superblock quorum"}
+    spill = state.meta.get("spill")
+    if not spill:
+        return {"note": "no spill/LSM state in this checkpoint"}
+    if not storage.layout.forest_blocks:
+        return {
+            "error": "checkpoint carries LSM state; pass --forest-blocks "
+            "matching the replica's layout to decode it"
+        }
+    from tigerbeetle_tpu.lsm.grid import Grid
+    from tigerbeetle_tpu.lsm.manifest_log import ManifestLog
+
+    grid = Grid(storage, offset=storage.layout.forest_offset,
+                block_count=storage.layout.forest_blocks)
+    mlog = ManifestLog(grid)
+    levels = mlog.restore(spill["manifest"]["manifest_log"])
+    names = _tree_names()
+    trees = []
+    for tid in sorted(levels):
+        per_level = []
+        for lv in sorted(levels[tid]):
+            infos = levels[tid][lv]
+            if not infos:
+                continue
+            per_level.append({
+                "level": lv,
+                "tables": len(infos),
+                "entries": sum(t.entry_count for t in infos),
+                "key_min": min(t.key_min for t in infos).hex(),
+                "key_max": max(t.key_max for t in infos).hex(),
+                "addresses": [t.index_address for t in infos],
+            })
+        if per_level:
+            trees.append({
+                "tree_id": tid,
+                "name": names.get(tid, f"tree {tid}"),
+                "levels": per_level,
+            })
+    return {
+        "manifest_blocks": spill["manifest"]["manifest_log"]["blocks"],
+        "manifest_events": spill["manifest"]["manifest_log"]["events"],
+        "trees": trees,
+    }
+
+
+# ----------------------------------------------------------------------
+# live mode
+# ----------------------------------------------------------------------
+
+INSPECT_CLIENT_ID = 0x7453_4550_534E_49  # "INSPECt" — above replica range
+
+
+def inspect_live(host: str, port: int, timeout: float = 5.0) -> dict:
+    """Ask a RUNNING replica for its [stats]-registry snapshot: connect
+    as a one-shot client, send a request_stats frame, parse the stats
+    reply (vsr/replica.py _on_request_stats). Works in any replica
+    status — a wedged server still answers from its event loop."""
+    import socket
+
+    req = Header(
+        command=int(Command.request_stats), client=INSPECT_CLIENT_ID
+    )
+    req.set_checksum_body(b"")
+    req.set_checksum()
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(req.to_bytes())
+        buf = b""
+        while True:
+            if len(buf) >= HEADER_SIZE:
+                header = Header.from_bytes(buf[:HEADER_SIZE])
+                if not (HEADER_SIZE <= header.size <= (1 << 20)):
+                    # garbage framing: the wrong port / not a replica —
+                    # error out instead of spinning on a 0-size frame
+                    raise RuntimeError(
+                        f"{host}:{port} is not speaking the VSR wire "
+                        f"format (frame size {header.size})"
+                    )
+                if len(buf) >= header.size:
+                    frame, buf = buf[: header.size], buf[header.size :]
+                    if header.command == int(Command.stats):
+                        if not header.valid_checksum():
+                            raise RuntimeError(
+                                "stats reply failed its checksum"
+                            )
+                        return json.loads(
+                            frame[HEADER_SIZE : header.size].decode()
+                        )
+                    continue  # other traffic (e.g. an eviction): skip
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                raise RuntimeError(
+                    "server closed the connection without a stats reply"
+                )
+            buf += chunk
+
+
+# ----------------------------------------------------------------------
+# rendering
+# ----------------------------------------------------------------------
+
+
+def _print_kv(prefix: str, d: dict, out) -> None:
+    for k, v in d.items():
+        out.write(f"{prefix}{k}: {v}\n")
+
+
+def render(topic: str, report: dict, out) -> None:
+    """Human rendering of one topic's report dict (the `--json` path
+    prints the dict itself)."""
+    if topic == "superblock":
+        for c in report["copies"]:
+            head = f"copy {c['copy']}: {c['verdict']}"
+            if c.get("checksum_ok"):
+                head += (
+                    f" — sequence {c['sequence']}, commit_min "
+                    f"{c['commit_min']}, view {c['view']}"
+                    f"/{c['log_view']}, area {c['area']}, "
+                    f"{len(c['blobs'])} blob(s)"
+                )
+            out.write(head + "\n")
+            for b in c.get("blobs", ()):
+                out.write(
+                    f"    blob {b['name']}: offset {b['offset']} "
+                    f"size {b['size']} checksum {b['checksum']}\n"
+                )
+        if report["quorum"] is None:
+            out.write("QUORUM: NONE — data file unopenable\n")
+        else:
+            out.write(
+                f"quorum: sequence {report['quorum']} "
+                f"({report['quorum_copies']}/"
+                f"{ZoneLayout.SUPERBLOCK_COPIES} copies)\n"
+            )
+    elif topic == "wal":
+        out.write(f"slot classes: {report['stats']}\n")
+        for s in report["slots"]:
+            line = f"slot {s['slot']:5d}  {s['class']:12s}"
+            p = s.get("prepare")
+            if p is not None:
+                line += (
+                    f" op {p['op']} {p['operation']} size {p['size']}"
+                    f" body_ok={p['body_ok']}"
+                )
+            elif "lost_op" in s:
+                line += f" lost op {s['lost_op']} (body unrecoverable here)"
+            out.write(line + "\n")
+        if "chain_end" in report:
+            out.write(
+                f"replayable chain: checkpoint op "
+                f"{report['checkpoint_op']} -> op {report['chain_end']}\n"
+            )
+            if report.get("chain_break"):
+                b = report["chain_break"]
+                out.write(
+                    f"TORN TAIL: chain breaks at op {b['op']} "
+                    f"(slot {b['slot']}): {b['why']}\n"
+                )
+    elif topic == "replies":
+        out.write(
+            f"{len(report['slots'])}/{report['slot_count']} reply "
+            "slots hold a valid reply\n"
+        )
+        for s in report["slots"]:
+            out.write(
+                f"slot {s['slot']:4d}: client {s['client']} request "
+                f"{s['request']} op {s['op']} {s['operation']} "
+                f"body_ok={s['body_ok']}\n"
+            )
+    elif topic == "grid":
+        _print_kv("", {k: v for k, v in report.items()
+                       if k not in ("blobs", "free_set")}, out)
+        for b in report.get("blobs", ()):
+            out.write(
+                f"blob {b['name']}: offset {b['offset']} size {b['size']} "
+                f"checksum_ok={b['checksum_ok']}\n"
+            )
+        fs = report.get("free_set")
+        if fs:
+            out.write(
+                f"free set: {fs['acquired']} acquired / {fs['free']} free "
+                f"of {fs['blocks']} blocks; corrupt: "
+                f"{fs['corrupt'] or 'none'}\n"
+            )
+    elif topic == "lsm":
+        if "trees" not in report:
+            _print_kv("", report, out)
+            return
+        out.write(
+            f"manifest log: {len(report['manifest_blocks'])} block(s), "
+            f"{report['manifest_events']} event(s)\n"
+        )
+        for t in report["trees"]:
+            out.write(f"{t['name']} (tree {t['tree_id']}):\n")
+            for lv in t["levels"]:
+                out.write(
+                    f"    L{lv['level']}: {lv['tables']} table(s), "
+                    f"{lv['entries']} entries, keys "
+                    f"[{lv['key_min']}, {lv['key_max']}]\n"
+                )
+    elif topic == "client-table":
+        _print_kv("", {k: v for k, v in report.items()
+                       if k != "entries"}, out)
+        for e in report.get("entries", ()):
+            out.write(
+                f"client {e['client']}: session {e['session']} request "
+                f"{e['request']} slot {e['slot']}\n"
+            )
+    else:  # wal-op dumps, live snapshots, anything structured
+        json.dump(report, out, indent=1, sort_keys=True)
+        out.write("\n")

@@ -52,7 +52,7 @@ import time as _time
 from tigerbeetle_tpu.io.network import Address, Handler, Network
 from tigerbeetle_tpu.metrics import NULL_METRICS
 from tigerbeetle_tpu.tracer import NULL_TRACER
-from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE, Command, Header, trace_id
 
 MESSAGE_SIZE_MAX_DEFAULT = 1 << 20
 
@@ -87,7 +87,7 @@ class MessagePool:
 class _Conn:
     __slots__ = (
         "sock", "peer", "connected", "rbuf", "roff", "wbuf",
-        "sessions", "strikes",
+        "sessions", "strikes", "pending_traces",
     )
 
     def __init__(self, sock: socket.socket, peer: Address | None = None,
@@ -104,6 +104,10 @@ class _Conn:
         # consecutive sends refused at the per-connection cap: the
         # wedged-consumer disconnect counter (reset on flush progress)
         self.strikes = 0
+        # tracing only: trace ids of reply frames queued in wbuf and not
+        # yet flushed — PER CONNECTION, so a flush span is tagged with
+        # exactly the replies that connection's write carried
+        self.pending_traces: list[int] = []
 
 
 class TCPMessageBus(Network):
@@ -234,8 +238,30 @@ class TCPMessageBus(Network):
             self._c_shed_pool.add()
             return "shed_pool"  # pool exhausted: backpressure
         conn.wbuf += data
+        if self.tracer.enabled and data[self._CMD_OFF] == _CMD_REPLY:
+            # the op's egress hop: tag the flush that carries this reply
+            # (tracked on the CONNECTION, so the tag lands on the flush
+            # that actually writes this conn — never a neighbor's)
+            conn.pending_traces.append(trace_id(
+                int.from_bytes(
+                    data[self._CLIENT_OFF : self._CLIENT_OFF + 16], "little"
+                ),
+                int.from_bytes(
+                    data[self._CONTEXT_OFF : self._CONTEXT_OFF + 16],
+                    "little",
+                ),
+            ))
         if len(conn.wbuf) >= self.FLUSH_EAGER:
-            self._flush(conn)  # large payloads start on the wire now
+            # large payloads start on the wire now; the eager flush
+            # carries THIS conn's reply trace ids itself — left pending
+            # they would mislabel the next flush_pending span
+            if conn.pending_traces:
+                traces, conn.pending_traces = conn.pending_traces, []
+                with self.tracer.span("bus.flush", conns=1,
+                                      traces=traces):
+                    self._flush(conn)
+            else:
+                self._flush(conn)
         return "sent"
 
     def flush_pending(self) -> None:
@@ -247,7 +273,13 @@ class TCPMessageBus(Network):
         if not pending:
             return
         self._c_flushes.add()
-        with self.tracer.span("bus.flush", conns=len(pending)):
+        traces: list[int] = []
+        for conn in pending:
+            if conn.pending_traces:
+                traces.extend(conn.pending_traces)
+                conn.pending_traces = []
+        with self.tracer.span("bus.flush", conns=len(pending),
+                              traces=traces):
             for conn in pending:
                 self._flush(conn)
 
@@ -420,6 +452,7 @@ class TCPMessageBus(Network):
     # 125. All cross-checked against Header at import.
     _SIZE_OFF = 120
     _CLIENT_OFF = 48
+    _CONTEXT_OFF = 64  # context u128 (request checksum on reply frames)
     _REQUEST_OFF = 80
     _CMD_OFF = 125
     _OP_OFF = 126  # `operation` u8
@@ -436,6 +469,10 @@ class TCPMessageBus(Network):
             if self.tracer.enabled and len(buf) - conn.roff >= HEADER_SIZE
             else 0
         )
+        # cluster-causal ingress anchor: the trace ids of the request
+        # frames this parse pass dispatches (annotated onto the span at
+        # the end — the ids are learned frame by frame)
+        parse_traces: list[int] = [] if tok else None
         mv = memoryview(buf)
         try:
             while len(buf) - conn.roff >= HEADER_SIZE:
@@ -492,6 +529,13 @@ class TCPMessageBus(Network):
                     )
                     if cid and self.conns.get(cid) is not conn:
                         self._alias(cid, conn)
+                    if parse_traces is not None and cid:
+                        # ingress: the trace id is ASSIGNED here, from
+                        # the request's own (client, checksum) pair
+                        parse_traces.append(trace_id(
+                            cid,
+                            int.from_bytes(frame[0:16], "little"),
+                        ))
                 if self.demux:
                     # session-multiplexed client bus: route by the
                     # frame's client id (replies/busy/eviction all carry
@@ -511,6 +555,8 @@ class TCPMessageBus(Network):
         finally:
             mv.release()
             if tok:
+                if parse_traces:
+                    self.tracer.annotate(tok, traces=parse_traces)
                 self.tracer.stop(tok)
         # compact ONCE per turn (a del per frame moved the whole tail —
         # O(bytes) per 1 MiB batch frame — on every message)
@@ -535,8 +581,9 @@ class TCPMessageBus(Network):
 # the framing/aliasing fast path peeks fields without parsing the header —
 # pin the offsets against the Header layout so they can never drift
 _CMD_REQUEST = int(Command.request)
+_CMD_REPLY = int(Command.reply)
 _pin = Header(
-    size=0x0BADF00D, client=0x0CAFE, request=0x0D15EA5E,
+    size=0x0BADF00D, client=0x0CAFE, context=0x0C0FFEE, request=0x0D15EA5E,
     command=int(Command.request), operation=0x42,
 ).to_bytes()
 assert int.from_bytes(
@@ -546,6 +593,10 @@ assert int.from_bytes(
     _pin[TCPMessageBus._CLIENT_OFF : TCPMessageBus._CLIENT_OFF + 16],
     "little",
 ) == 0x0CAFE
+assert int.from_bytes(
+    _pin[TCPMessageBus._CONTEXT_OFF : TCPMessageBus._CONTEXT_OFF + 16],
+    "little",
+) == 0x0C0FFEE
 assert int.from_bytes(
     _pin[TCPMessageBus._REQUEST_OFF : TCPMessageBus._REQUEST_OFF + 4],
     "little",

@@ -429,6 +429,9 @@ CATALOG = {
     "ingress.disconnect_wedged": ("counter", "conns", "wedged consumers cut at the strike limit"),
     "ingress.fanout_consumers": ("gauge", "consumers", "CDC fan-out consumers on one tail"),
     "ingress.fanout_lag_ops": ("gauge", "ops", "slowest fan-out consumer vs the watermark"),
+    # cluster-causal tracing + introspection (tracer.py, inspect.py)
+    "trace.sigquit_dumps": ("counter", "", "SIGQUIT hang-diagnosis dumps taken"),
+    "inspect.live_requests": ("counter", "", "live [stats] snapshots served over the wire"),
     # bench driver
     "bench.batch_latency_us": ("histogram", "us", "synced single-batch dispatch latency"),
 }

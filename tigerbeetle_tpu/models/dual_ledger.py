@@ -448,12 +448,13 @@ class DualLedger:
 
     def _apply_loop(self) -> None:
         """One loop serves both modes (the generalized shadow loop): items
-        are (op, operation, ts, arr, codes, prepare_checksum) — shadow
-        mode enqueues op=None/codes=None (digests fold via the engine
-        done-callbacks instead), follower mode carries the committed op
-        number, the native dense codes, and the prepare checksum. Control
-        items (first element a str) re-seed/reset the device between
-        runs."""
+        are (op, operation, ts, arr, codes, prepare_checksum, trace) —
+        shadow mode enqueues op=None/codes=None/trace=0 (digests fold via
+        the engine done-callbacks instead), follower mode carries the
+        committed op number, the native dense codes, the prepare checksum
+        and the op's cluster-causal trace id (tags the shadow.upload
+        span). Control items (first element a str) re-seed/reset the
+        device between runs."""
         import time as _time
 
         import jax
@@ -489,7 +490,7 @@ class DualLedger:
             order (follower mode; runs are consumed in queue order so the
             chain matches the commit stream)."""
             nonlocal chk_nat
-            for op2, _o, _t, _a, codes, prep in items:
+            for op2, _o, _t, _a, codes, prep, _tr in items:
                 chk_nat = fold_reply_codes_np(chk_nat, codes)
                 self._op_ring[op2 % APPLY_RING] = (op2, prep, chk_nat)
 
@@ -570,15 +571,16 @@ class DualLedger:
                     if j - i >= 2:
                         t_stage = _time.perf_counter()
                         with self.tracer.span("shadow.upload",
-                                              batches=j - i):
+                                              batches=j - i,
+                                              trace=run[i][6]):
                             pendings = self.device.try_execute_group_async(
-                                [(t, a) for _, _, t, a, _, _ in run[i:j]]
+                                [(t, a) for _, _, t, a, *_ in run[i:j]]
                             )
                     if pendings is not None:
                         g = pendings[0].group
                         m = j - i
                         ns = np.zeros(g.k, dtype=np.int32)
-                        ns[:m] = [len(a) for _, _, _, a, _, _ in run[i:j]]
+                        ns[:m] = [len(a) for _, _, _, a, *_ in run[i:j]]
                         active = np.zeros(g.k, dtype=bool)
                         active[:m] = True
                         if self.follower:
@@ -638,8 +640,9 @@ class DualLedger:
                         end = j if j > i else i + 1
                         t_stage = _time.perf_counter()
                         with self.tracer.span("shadow.upload",
-                                              batches=end - i, solo=True):
-                            for op2, opn2, ts2, arr2, _c, _p in run[i:end]:
+                                              batches=end - i, solo=True,
+                                              trace=run[i][6]):
+                            for op2, opn2, ts2, arr2, _c, _p, _tr in run[i:end]:
                                 pending = self.device.execute_async(
                                     opn2, ts2, arr2
                                 )
@@ -692,14 +695,14 @@ class DualLedger:
         the hash-log ring must localize). Whole-batch corruption — a
         single-lane flip could land on an event that was already invalid
         and change nothing."""
-        op2, opn2, ts2, arr2, codes, prep = item
+        op2, opn2, ts2, arr2, codes, prep, tr = item
         bad = arr2.copy()
         if opn2 == Operation.create_transfers:
             bad["debit_account_id_lo"][:] = 0xDEAD_BEEF_DEAD_BEEF
             bad["debit_account_id_hi"][:] = 0xDEAD_BEEF_DEAD_BEEF
         else:
             bad["ledger"][:] = 0  # ledger_must_not_be_zero on valid lanes
-        return (op2, opn2, ts2, bad, codes, prep)
+        return (op2, opn2, ts2, bad, codes, prep, tr)
 
     def _apply_install(self, raw: bytes, dev_ring):
         """Handle an _INSTALL control item ON the apply thread: re-seed
@@ -747,18 +750,24 @@ class DualLedger:
         arr: np.ndarray,
         codes: np.ndarray,
         prepare_checksum: int = 0,
+        trace: int = 0,
     ) -> None:
         """Enqueue one COMMITTED op for the device applier (follower
         mode): called by the replica at commit finalize, in op order,
         with the event rows (a read-only view over the prepare body) and
         the native engine's dense reply codes. The bounded queue
         backpressures the event loop only as a last resort — admission
-        throttling via apply_lag_excess() engages first."""
+        throttling via apply_lag_excess() engages first. `trace` is the
+        op's cluster-causal trace id (vsr/header.py): the apply loop tags
+        its shadow.upload span with the run's first id, so the device
+        hop joins the op's Perfetto flow."""
         assert self.follower
         self._enqueued_op = op
         self._enq_ops += 1
         self._put_seq += 1
-        self._q.put((op, operation, timestamp, arr, codes, prepare_checksum))
+        self._q.put(
+            (op, operation, timestamp, arr, codes, prepare_checksum, trace)
+        )
 
     def apply_lag_ops(self) -> int:
         """Committed-but-not-yet-device-applied CREATE ops (enqueued
@@ -796,7 +805,7 @@ class DualLedger:
         # the queue bounds host-memory growth; a full queue briefly
         # backpressures the event loop rather than dropping shadow batches
         # (a dropped batch would be an unverifiable run, not a fast one)
-        self._q.put((None, operation, timestamp, arr, None, 0))
+        self._q.put((None, operation, timestamp, arr, None, 0, 0))
 
     def _fold_native(self, pending) -> None:
         """Chain the native codes into the host-side digest when the engine

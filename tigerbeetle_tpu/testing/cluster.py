@@ -37,6 +37,7 @@ class Cluster:
         standby_count: int = 0,
         metrics=None,
         tracer=None,
+        tracer_factory=None,
     ):
         from tigerbeetle_tpu.constants import TEST_CLUSTER, TEST_PROCESS
 
@@ -69,8 +70,11 @@ class Cluster:
                 backend_factory=backend_factory,
                 standby_count=standby_count,
                 # observability pass-through: a harness can hand every
-                # replica one shared registry/tracer (tests do)
-                metrics=metrics, tracer=tracer,
+                # replica one shared registry/tracer (tests do), or a
+                # tracer PER replica via tracer_factory(i) — the shape
+                # the cluster-causal stitch tests use (pid = index)
+                metrics=metrics,
+                tracer=tracer_factory(i) if tracer_factory else tracer,
             )
             # thread timing must not leak into deterministic runs
             r.sync_payload_async = False

@@ -452,13 +452,23 @@ class Simulator:
         # two dumps of a diverging VOPR seed can be diffed directly. The
         # tracer is pure observation: enabling it must leave the committed
         # history unchanged (tested in tests/test_metrics.py).
+        #
+        # ONE tracer PER REPLICA (pid = replica index), surviving that
+        # replica's crash/restarts, and the dump is the STITCHED cluster
+        # trace (tracer.stitch): every span tagged with an op's trace id
+        # (vsr/header.py trace_id) becomes a Perfetto flow linking the
+        # op's legs across replica pids — and because ticks, ring
+        # contents and the stitch are all deterministic, the same seed
+        # still dumps byte-identical files.
         self.trace_path = trace_path
+        self.tracers = None
         if trace_path is not None:
             from tigerbeetle_tpu.tracer import SimTracer
 
-            self.tracer = SimTracer(clock=lambda: self.net.tick_now)
-        else:
-            self.tracer = None
+            self.tracers = [
+                SimTracer(clock=lambda: self.net.tick_now, pid=i)
+                for i in range(replica_count + standby_count)
+            ]
 
         self.net = PacketSimulator(
             seed * 31 + 1, self.total_replicas,
@@ -566,7 +576,7 @@ class Simulator:
             self.cluster_config, self.process_config,
             backend_factory=self.backend_factory,
             standby_count=self.standby_count,
-            tracer=self.tracer,
+            tracer=self.tracers[i] if self.tracers is not None else None,
         )
         hist = self.histories[i]
 
@@ -843,8 +853,18 @@ class Simulator:
         finally:
             # dump even when a checker raises: a diverging seed's trace is
             # exactly the artifact worth diffing against a healthy replay
-            if self.tracer is not None and self.trace_path is not None:
-                self.tracer.dump(self.trace_path)
+            if self.tracers is not None and self.trace_path is not None:
+                from tigerbeetle_tpu.tracer import dump_stitched
+
+                dump_stitched(
+                    self.trace_path,
+                    [tr.events_ordered() for tr in self.tracers],
+                    labels=[
+                        f"replica {i}" if i < self.replica_count
+                        else f"standby {i}"
+                        for i in range(len(self.tracers))
+                    ],
+                )
             # ...and a failing seed's hash-log recording is the artifact a
             # replay checks against (save in the finally for the same
             # reason the trace dumps there)

@@ -42,6 +42,10 @@ class Tracer:
     def stop(self, token: int) -> None:
         pass
 
+    def annotate(self, token: int, **args) -> None:
+        """Attach args to a still-open span (facts learned mid-span, e.g.
+        the trace ids of the frames a bus.frame_parse pass dispatched)."""
+
     def span(self, name: str, **args):
         return _NULL_SPAN
 
@@ -96,6 +100,12 @@ class JsonTracer(Tracer):
             self._next += 1
             self._open[token] = (name, self.clock(), args)
         return token
+
+    def annotate(self, token: int, **args) -> None:
+        with self._lock:
+            entry = self._open.get(token)
+            if entry is not None:
+                entry[2].update(args)
 
     def stop(self, token: int) -> None:
         now = self.clock()
@@ -159,6 +169,102 @@ class SimTracer(JsonTracer):
     def __init__(self, clock, capacity: int = 65536, pid: int = 0):
         super().__init__(capacity=capacity, clock=clock, ts_div=1.0,
                          pid=pid)
+
+
+# -- cluster-causal stitching ------------------------------------------
+#
+# Spans tagged with a trace id (args `trace` = one u64, or `traces` = a
+# list of them — vsr/header.py trace_id) become Perfetto FLOW events at
+# stitch time: for each id that appears in at least two spans, the first
+# occurrence emits a flow-start ("s"), the last a flow-end ("f", bound to
+# the enclosing slice), and everything between a step ("t") — clicking
+# any leg of an op in Perfetto then draws arrows through its whole
+# causal tree across processes. Flows are GENERATED from the surviving
+# span events (never recorded into the ring), so a ring that overwrote
+# an op's early spans simply shortens its flow — a dangling flow id is
+# impossible by construction, and stitching is a pure deterministic
+# function of the dumps (same-seed simulator runs stitch byte-identical).
+
+
+def _span_trace_ids(event: dict) -> list[int]:
+    args = event.get("args") or {}
+    out = []
+    t = args.get("trace")
+    if t:
+        out.append(t)
+    for t in args.get("traces") or ():
+        if t:
+            out.append(t)
+    return out
+
+
+def flow_events(events: list[dict]) -> list[dict]:
+    """Generate s/t/f flow events from the trace tags of `events`
+    (complete or incomplete span events, any mix of pids). Ids seen in
+    only ONE span emit nothing — a one-point flow is noise and a lone
+    start would dangle."""
+    occurrences: dict[int, list[tuple]] = {}
+    for i, e in enumerate(events):
+        if e.get("ph") not in ("X", "B"):
+            continue
+        for t in _span_trace_ids(e):
+            occurrences.setdefault(t, []).append(
+                (e["ts"], e["pid"], e.get("tid", 0), i)
+            )
+    flows: list[dict] = []
+    for t in sorted(occurrences):
+        occ = occurrences[t]
+        if len(occ) < 2:
+            continue
+        occ.sort()  # (ts, pid, tid, event index): canonical causal order
+        for j, (ts, pid, tid, _i) in enumerate(occ):
+            ph = "s" if j == 0 else ("f" if j == len(occ) - 1 else "t")
+            ev = {
+                "ph": ph,
+                "cat": "op",
+                "name": "op",
+                "id": f"{t:x}",
+                "ts": ts,
+                "pid": pid,
+                "tid": tid,
+            }
+            if ph == "f":
+                ev["bp"] = "e"  # bind the end to the ENCLOSING slice
+            flows.append(ev)
+    return flows
+
+
+def stitch(event_lists: list[list[dict]],
+           labels: list[str] | None = None) -> list[dict]:
+    """Merge per-process span dumps into ONE event list: dump i's events
+    are re-assigned pid=i (each process traced with its own local pid 0),
+    named via process_name metadata, and the cross-process flow events
+    are generated over the union. Pure + deterministic: byte-identical
+    inputs stitch byte-identically."""
+    out: list[dict] = []
+    for pid in range(len(event_lists)):
+        label = labels[pid] if labels and pid < len(labels) else f"pid {pid}"
+        out.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "ts": 0, "args": {"name": label},
+        })
+    for pid, events in enumerate(event_lists):
+        for e in events:
+            out.append(dict(e, pid=pid))
+    out.extend(flow_events(out))
+    return out
+
+
+def dump_stitched(path: str, event_lists: list[list[dict]],
+                  labels: list[str] | None = None) -> int:
+    """Write a stitched trace as canonical JSON (sorted keys, fixed
+    separators — the same byte-reproducibility contract as
+    JsonTracer.dump). Returns the stitched event count."""
+    events = stitch(event_lists, labels)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f,
+                  sort_keys=True, separators=(",", ":"))
+    return len(events)
 
 
 class _Span:

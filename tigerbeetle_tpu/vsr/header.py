@@ -35,6 +35,35 @@ assert _WIRE.size == HEADER_SIZE
 _U64 = 0xFFFFFFFFFFFFFFFF
 
 
+def trace_id(client: int, request_checksum: int) -> int:
+    """Cluster-causal trace id: a u64 derived DETERMINISTICALLY from
+    (client id, request checksum) — the pair that uniquely names one
+    client request cluster-wide — so every process that sees any leg of
+    the op derives the SAME id with no coordination, and the simulator's
+    traces stay byte-reproducible (no RNG, no wall clock).
+
+    The carrier is the header's `context` field: the primary already
+    stamps every prepare with context = the request's checksum (the
+    reserved use of context on the prepare/reply path), so prepares,
+    journal slots, replies and CDC records all carry enough to re-derive
+    the id — the trace identity propagates with the consensus stream
+    itself, costing zero extra wire bytes. splitmix64 finalizers over
+    the folded u128s — the client mixes BEFORE the checksum folds in, so
+    the derivation is not symmetric in its arguments: cheap, well-mixed,
+    pure int math."""
+    c = (client ^ (client >> 64)) & _U64
+    s = (request_checksum ^ (request_checksum >> 64)) & _U64
+    x = (c + 0x9E3779B97F4A7C15) & _U64
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & _U64
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EB & _U64
+    x = (x ^ (x >> 31)) ^ s
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & _U64
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EB & _U64
+    x ^= x >> 31
+    # never 0: 0 is the "untraced" sentinel in span args
+    return x or 1
+
+
 class Command(enum.IntEnum):
     """VSR protocol commands (reference: src/vsr.zig:111-154)."""
 
@@ -71,6 +100,13 @@ class Command(enum.IntEnum):
     # `busy` echoing the client + request number — the client backs off
     # and retries, instead of timing out against a silent drop.
     busy = 27
+    # Live introspection (`tigerbeetle inspect live`, inspect.py): a
+    # request_stats frame asks a running replica for its [stats]-registry
+    # snapshot + basic consensus state; the `stats` reply carries the
+    # JSON body. Served in ANY status — the whole point is to look at a
+    # replica that is wedged mid-view-change or mid-recovery.
+    request_stats = 28
+    stats = 29
 
 
 # Vectorized view of the same layout (batch scans over header rings);
@@ -149,6 +185,18 @@ class Header:
             size=v[17], replica=v[18], command=v[19], operation=v[20],
             version=v[21],
         )
+
+    # -- tracing --
+
+    def trace(self) -> int:
+        """The op's cluster-causal trace id, derived from the fields THIS
+        header carries: a request hashes its own checksum (ingress — the
+        id is assigned here); prepares and replies carry the request
+        checksum in `context` (see trace_id). Only meaningful for
+        request/prepare/reply-shaped headers."""
+        if self.command == int(Command.request):
+            return trace_id(self.client, self.checksum)
+        return trace_id(self.client, self.context)
 
     # -- checksums (reference: src/vsr.zig:428-442 set/valid pattern) --
 

@@ -81,8 +81,10 @@ class Journal:
         assert header.size == HEADER_SIZE + len(body)
         assert header.size <= self.msg_max
         slot = self.slot_for_op(header.op)
-        with self.tracer.span("journal.write_prepare", op=header.op), \
-                self.metrics.histogram("journal.write_us").time():
+        with self.tracer.span(
+            "journal.write_prepare", op=header.op,
+            trace=header.trace() if self.tracer.enabled else 0,
+        ), self.metrics.histogram("journal.write_us").time():
             self.storage.write(
                 Zone.wal_prepares, slot * self.msg_max,
                 header.to_bytes() + body,
@@ -133,8 +135,13 @@ class Journal:
             )
         # header and body ship separately: the 1 MiB header+body concat
         # happens on the WRITER thread, not the event loop (a measured
-        # per-batch copy on the reply-serving core)
-        fut = self._executor.submit(self._write_task, slot, sector, hb, body)
+        # per-batch copy on the reply-serving core). The trace id is
+        # derived HERE (event loop, header in hand) and handed to the
+        # worker as a plain int for its span tag.
+        tid = header.trace() if self.tracer.enabled else 0
+        fut = self._executor.submit(
+            self._write_task, slot, sector, hb, body, tid
+        )
         self._pending_writes.add(fut)
         fut.add_done_callback(self._pending_writes.discard)
         return fut
@@ -170,13 +177,14 @@ class Journal:
             fut.result()
 
     def _write_task(self, slot: int, sector: int, hb: bytes,
-                    body: bytes) -> None:
+                    body: bytes, tid: int = 0) -> None:
         # prepare FIRST, then the redundant header (same ordering contract
         # as the sync path). Concurrent slots may share a header sector:
         # a slot's header enters the DURABLE mirror only here — after its
         # own prepare landed — so a neighbor's sector write can never
         # publish a header whose prepare is still in flight.
-        with self.tracer.span("journal.write_prepare", slot=slot), \
+        with self.tracer.span("journal.write_prepare", slot=slot,
+                              trace=tid), \
                 self.metrics.histogram("journal.write_us").time():
             self.storage.write(
                 Zone.wal_prepares, slot * self.msg_max, hb + body

@@ -696,6 +696,9 @@ class Replica:
                 self.time.monotonic(),
             )
             return
+        if cmd == Command.request_stats:
+            self._on_request_stats(header)
+            return
         if cmd == Command.request_prepare:
             self._on_request_prepare(header)
             return
@@ -914,8 +917,12 @@ class Replica:
                              "oks": {self.replica}, "wal": wal,
                              # quorum-wait accounting: broadcast -> quorum
                              "t": perf_counter_ns(),
+                             # ingress anchor of the op's causal trace:
+                             # the id every later span derives from the
+                             # prepare's (client, context) pair
                              "qtok": self.tracer.start(
-                                 "replica.quorum_wait", op=op)}
+                                 "replica.quorum_wait", op=op,
+                                 trace=self._tid(prepare))}
         # Stream prepares to standbys too (they journal + commit but never
         # ack — _ack_prepare declines): without this a standby would learn
         # each op only via a commit heartbeat plus one request_prepare round
@@ -1046,6 +1053,39 @@ class Replica:
         self.network.send(
             self.replica, header.replica, p_header.to_bytes() + body
         )
+
+    # ------------------------------------------------------------------
+    # live introspection (`tigerbeetle inspect live`, inspect.py)
+    # ------------------------------------------------------------------
+
+    def _on_request_stats(self, header: Header) -> None:
+        """Serve the live [stats] snapshot over the wire: the metric
+        registry plus the consensus state an operator asks about first.
+        Answered in ANY status — a wedged replica is exactly the one
+        worth inspecting — and routed back to the asking client id (the
+        bus learned the peer from this very frame)."""
+        self.metrics.counter("inspect.live_requests").add()
+        snap = {
+            "replica": self.replica,
+            "status": self.status,
+            "view": self.view,
+            "op": self.op,
+            "commit_min": self.commit_min,
+            "commit_max": self.commit_max,
+            "checkpoint_op": self.checkpoint_op,
+            "pipeline": len(self.pipeline),
+            "inflight": len(self._inflight),
+            "sessions": len(self.client_table),
+            "metrics": self.metrics.snapshot(),
+        }
+        body = _json.dumps(snap, sort_keys=True).encode()
+        if HEADER_SIZE + len(body) > self.cluster.message_size_max:
+            # a registry too large for one frame loses its detail, never
+            # its validity: the consensus state is the part that must land
+            snap["metrics"] = {"truncated": True}
+            body = _json.dumps(snap, sort_keys=True).encode()
+        reply = Header(command=int(Command.stats), client=header.client)
+        self._send(header.client or header.replica, reply, body)
 
     # ------------------------------------------------------------------
     # grid block repair: a corrupt forest block heals from any peer that
@@ -1537,6 +1577,12 @@ class Replica:
             return
         spill.prefetch_async(np.frombuffer(body, dtype=TRANSFER_DTYPE))
 
+    def _tid(self, header: Header) -> int:
+        """The op's cluster-causal trace id (vsr/header.py trace_id) for
+        span tagging — 0 (untraced) when tracing is off, so the hot path
+        never pays the hash for the no-op backend."""
+        return header.trace() if self.tracer.enabled else 0
+
     def _drop_quorum_tokens(self) -> None:
         """Close the quorum-wait spans of pipeline entries about to be
         discarded (view change): without this a traced run leaks one open
@@ -1744,7 +1790,8 @@ class Replica:
 
     def _commit_dispatch(self, header: Header, body: bytes,
                          handle=None) -> dict:
-        with self.tracer.span("replica.commit_dispatch", op=header.op), \
+        with self.tracer.span("replica.commit_dispatch", op=header.op,
+                              trace=self._tid(header)), \
                 self._h_dispatch.time():
             return self._commit_dispatch_inner(header, body, handle)
 
@@ -1831,7 +1878,8 @@ class Replica:
 
     def _commit_finalize(self, entry: dict) -> bytes | None:
         with self.tracer.span("replica.commit_finalize",
-                              op=entry["header"].op), \
+                              op=entry["header"].op,
+                              trace=self._tid(entry["header"])), \
                 self._h_finalize.time():
             return self._commit_finalize_inner(entry)
 
@@ -1900,6 +1948,7 @@ class Replica:
                 ),
                 entry["handle"][1].codes,
                 prepare_checksum=header.checksum,
+                trace=self._tid(header),
             )
         self.cdc_commit_min = header.op
         wire = reply.to_bytes() + reply_body
@@ -2053,8 +2102,11 @@ class Replica:
         if self._fuse_started is None:
             self._fuse_started = now
             self.group_stats.add("fuse_holds")
+            # tagged with the FIRST held op's trace id: clicking the op
+            # in Perfetto shows the hold it waited out
             self._fuse_token = self.tracer.start(
-                "replica.fuse_hold", run=run
+                "replica.fuse_hold", run=run,
+                trace=self._tid(self.pipeline[first]["header"]),
             )
             return True
         if now - self._fuse_started < self.fuse_window_ns:

@@ -107,33 +107,59 @@ class SuperBlock:
                 self.storage.sync()
         self.state = state
 
+    @staticmethod
+    def decode_copy(raw: bytes) -> tuple[VSRState | None, str]:
+        """Decode ONE copy's raw bytes -> (state, verdict). The single
+        implementation of the copy wire format, shared by the quorum
+        open and `tigerbeetle inspect superblock` (which reports every
+        copy's verdict instead of silently skipping the bad ones)."""
+        if int.from_bytes(raw[0:8], "little") != MAGIC:
+            return None, "bad magic"
+        length = int.from_bytes(raw[8:16], "little")
+        if length + 32 > len(raw):
+            return None, "length overflows the copy"
+        want = int.from_bytes(raw[16:32], "little")
+        payload = raw[32 : 32 + length]
+        if native.checksum(payload) != want:
+            return None, "payload checksum mismatch"
+        return VSRState.from_bytes(payload), "valid"
+
+    @staticmethod
+    def quorum_winner(
+        states: list[VSRState | None],
+    ) -> tuple[VSRState | None, int]:
+        """The quorum rule in ONE place (shared with `tigerbeetle
+        inspect`, which must report the same winner the replica would
+        open): (winning state, number of copies carrying it), or
+        (None, 0) when no sequence reaches QUORUM valid copies."""
+        by_seq: dict[int, int] = {}
+        by_state: dict[int, VSRState] = {}
+        for st in states:
+            if st is None:
+                continue
+            by_seq[st.sequence] = by_seq.get(st.sequence, 0) + 1
+            by_state[st.sequence] = st
+        quorate = [s for s, n in by_seq.items() if n >= QUORUM]
+        if not quorate:
+            return None, 0
+        winner = max(quorate)
+        return by_state[winner], by_seq[winner]
+
     def open(self) -> VSRState:
         """Quorum read: the highest sequence with >= QUORUM valid copies."""
-        by_seq: dict[int, int] = {}
-        states: dict[int, VSRState] = {}
-        for copy in range(ZoneLayout.SUPERBLOCK_COPIES):
-            raw = self.storage.read(
+        decoded = [
+            self.decode_copy(self.storage.read(
                 Zone.superblock,
                 copy * ZoneLayout.SUPERBLOCK_COPY_SIZE,
                 ZoneLayout.SUPERBLOCK_COPY_SIZE,
-            )
-            if int.from_bytes(raw[0:8], "little") != MAGIC:
-                continue
-            length = int.from_bytes(raw[8:16], "little")
-            if length + 32 > len(raw):
-                continue
-            want = int.from_bytes(raw[16:32], "little")
-            payload = raw[32 : 32 + length]
-            if native.checksum(payload) != want:
-                continue
-            st = VSRState.from_bytes(payload)
-            by_seq[st.sequence] = by_seq.get(st.sequence, 0) + 1
-            states[st.sequence] = st
-        quorate = [s for s, n in by_seq.items() if n >= QUORUM]
-        if not quorate:
+            ))[0]
+            for copy in range(ZoneLayout.SUPERBLOCK_COPIES)
+        ]
+        state, _copies = self.quorum_winner(decoded)
+        if state is None:
             raise RuntimeError(
                 "superblock: no sequence with a quorum of valid copies "
-                f"(found {by_seq}) — data file corrupt or not formatted"
+                "— data file corrupt or not formatted"
             )
-        self.state = states[max(quorate)]
+        self.state = state
         return self.state
