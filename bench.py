@@ -754,6 +754,38 @@ def bench_ingress(stage) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def bench_failover(stage) -> dict:
+    """The failover segment (live chaos harness, testing/chaos.py): a
+    real 3-replica cluster under a multiplexed fleet, the primary
+    SIGKILLed mid-run — reports failover_recovery_ms (kill to first
+    client reply) and the post-failover throughput ratio, with zero
+    lost/duplicated transfers verified (conservation + CDC). Host-only
+    like the other live segments: the servers own the accelerator."""
+    log = lambda *a: print("[failover]", *a, file=sys.stderr)  # noqa: E731
+    try:
+        with stage("failover"):
+            from tigerbeetle_tpu.testing.chaos import run_failover
+
+            return run_failover(
+                n_sessions=int(os.environ.get("BENCH_FAILOVER_SESSIONS",
+                                              128)),
+                conns=8,
+                events_per_batch=int(
+                    os.environ.get("BENCH_FAILOVER_EVENTS", 64)
+                ),
+                batches_per_session=int(
+                    os.environ.get("BENCH_FAILOVER_BATCHES", 10)
+                ),
+                backend=os.environ.get("BENCH_FAILOVER_BACKEND", "native"),
+                jax_platform=None,  # servers inherit the rig's platform
+                log=log,
+            )
+    except Exception as e:  # never sink the kernel benchmark
+        print(f"[failover] FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _parse_trace_arg(argv) -> str | None:
     """`--trace <path>` / `--trace=<path>`: dump a merged Chrome
     trace-event JSON (driver spans + the first e2e server's spans) there."""
@@ -789,6 +821,7 @@ def main() -> None:
     # E2E first: host-only in this process (subprocess server owns the TPU)
     e2e = bench_e2e(stage, trace=bool(trace_path))
     ingress = bench_ingress(stage)
+    failover = bench_failover(stage)
 
     import jax
     import jax.numpy as jnp
@@ -1087,7 +1120,8 @@ def main() -> None:
     # metrics, server stats, tracked configs — goes to BENCH_DETAIL.json
     # next to this script plus stderr.
     server_trace_events = e2e.pop("trace_events", None)
-    detail = {"durable": e2e, "ingress": ingress, "configs": configs,
+    detail = {"durable": e2e, "ingress": ingress, "failover": failover,
+              "configs": configs,
               "stages_s": {
                   k: round(v, 2) for k, v in stages.items()
               }}
@@ -1209,6 +1243,17 @@ def main() -> None:
                 ),
                 "ingress_shed": ingress.get("ingress_shed"),
                 "ingress_busy_replies": ingress.get("busy_replies"),
+                # failover: the primary SIGKILLed under live multiplexed
+                # load — kill-to-first-reply ms and the throughput ratio
+                # after recovery, with zero lost/duplicated transfers
+                # proven (conservation + CDC); full report in detail
+                "failover_recovery_ms": failover.get(
+                    "failover_recovery_ms"
+                ),
+                "failover_tps_ratio": failover.get(
+                    "post_failover_tps_ratio"
+                ),
+                "failover_lost_events": failover.get("lost_events"),
             }
         )
     )

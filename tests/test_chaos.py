@@ -1,0 +1,93 @@
+"""Live-cluster chaos (testing/chaos.py): real replica processes over
+TCP, a multiplexed fleet on the client runtime, live faults, and the
+three-way zero-lost/zero-duplicated verification.
+
+The runs happen in a SUBPROCESS (scripts/chaos.py --json): the harness
+drives sockets/signals/subprocess groups, and keeping all of that out
+of the pytest process keeps this sandbox's documented XLA-CPU/native
+fragility (see CHANGES.md, PRs 1-9) away from the in-process device
+tests that run after this file.
+
+The tier-1 smoke runs ONE kill/restart cycle (with the WAL disk-fault
+flip) against a small native-backend cluster on CPU; the full storm —
+1k sessions, dual backend, every fault class — is `slow` (it is also
+the acceptance drive scripts/chaos.py runs standalone)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_chaos_cli(tmp_path, *args, timeout=600):
+    report_path = tmp_path / "chaos_report.json"
+    env = dict(os.environ, TB_JAX_PLATFORM="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "chaos.py"),
+         "--json", str(report_path), *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"chaos run failed (rc {proc.returncode}):\n"
+        f"{proc.stderr[-4000:]}\n{proc.stdout[-2000:]}"
+    )
+    with open(report_path) as f:
+        return json.load(f)
+
+
+def test_chaos_smoke_primary_kill_restart(tmp_path):
+    """One SIGKILL of the primary under live multiplexed load, restart
+    with a disk-fault flip: zero lost/duplicated transfers (client
+    replies vs CDC vs wire conservation) and a recovery time reported —
+    all client recovery driven by the runtime, no driver retries."""
+    report = _run_chaos_cli(
+        tmp_path,
+        "--sessions", "12", "--conns", "2", "--accounts", "32",
+        "--events-per-batch", "4", "--batches-per-session", "3",
+        "--backend", "native", "--faults", "kill_primary",
+        "--restart-after", "1.0", "--deadline", "240",
+        timeout=420,
+    )
+    assert report["kills"] == 1
+    assert report["restarts"] == 1
+    assert report["lost_events"] == 0
+    assert report["acked_events"] == 12 * 3 * 4
+    assert report["conservation_ok"]
+    assert report["disk_fault_slots"]  # the flip actually landed
+    assert report["failover_recovery_ms"] is not None
+    assert report["cdc"]["dup_ids"] == 0
+    assert report["cdc"]["transfers_bad"] == 0
+    # the fleet recovered through the RUNTIME: timeouts/resends fired
+    assert report["client"]["timeouts"] > 0
+    assert report["client"]["resends"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_full_storm_dual_backend(tmp_path):
+    """The acceptance drive: >= 1k multiplexed sessions against a
+    3-replica `--backend dual` cluster, primary SIGKILL + SIGSTOP gray
+    failure + backup kill + connection resets + a disk-fault restart —
+    zero lost/duplicated transfers and per-replica device hash-log
+    parity after the storm."""
+    report = _run_chaos_cli(
+        tmp_path,
+        "--sessions", "1000", "--conns", "16", "--accounts", "256",
+        "--events-per-batch", "4", "--batches-per-session", "3",
+        "--backend", "dual",
+        "--faults", "kill_primary,gray_primary,kill_backup,reset_conns",
+        "--deadline", "900",
+        timeout=1800,
+    )
+    assert report["kills"] == 2 and report["restarts"] == 2
+    assert report["gray_stops"] == 1 and report["conn_resets"] == 1
+    assert report["lost_events"] == 0
+    assert report["conservation_ok"]
+    assert report["cdc"]["dup_ids"] == 0
+    assert report["failover_recovery_ms"] is not None
+    for name, p in report["parity"].items():
+        assert p["verified"], (name, p)
+        assert p["hash_log_ok"] is not False, (name, p)

@@ -1,0 +1,437 @@
+"""The fault-tolerant client runtime (vsr/client.py tick state machine):
+typed errors from the wait path, strict stale-busy handling, the
+timeout -> re-target -> duplicate-reply-dedup ladder, busy backoff
+distinct from loss backoff, ping/pong view discovery, per-request
+deadlines, eviction -> automatic re-registration — each scripted
+deterministically over the in-process cluster, then the whole state
+machine under the seeded simulator's fault matrix with byte-identical
+histories per seed."""
+
+import pytest
+
+from tigerbeetle_tpu.constants import ConfigCluster
+from tigerbeetle_tpu.io.network import LinkControl
+from tigerbeetle_tpu.metrics import Metrics
+from tigerbeetle_tpu.models.oracle import OracleStateMachine
+from tigerbeetle_tpu.testing.cluster import Cluster as _Cluster
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.client import (
+    Client,
+    RequestTimeout,
+    SessionEvicted,
+    Timeout,
+    WallTicker,
+)
+from tigerbeetle_tpu.vsr.header import Command, Header
+
+CID = (1 << 64) + 77
+
+
+def Cluster(**kw):
+    # oracle backend throughout: these tests exercise CLIENT behavior —
+    # keeping the device ledger out makes them fast and keeps this
+    # sandbox's documented XLA-CPU/native fragility (CHANGES.md) away
+    # from extra in-process device work
+    return _Cluster(backend_factory=OracleStateMachine, **kw)
+
+
+def _accounts_batch(seed: int = 5, n: int = 4) -> bytes:
+    # explicit valid accounts in a per-`seed` id range (the workload
+    # GENERATOR deliberately mixes invalid events — wrong tool here)
+    from tigerbeetle_tpu.benchmark import _accounts_body
+
+    return _accounts_body(1 + seed * 1000, n)
+
+
+def _register(cluster: Cluster, client: Client) -> None:
+    client.register()
+    cluster.network.run()
+    client.take_reply()
+    assert client.session != 0
+
+
+# ----------------------------------------------------------------------
+# satellite: eviction surfaces as a typed error from the wait path
+# ----------------------------------------------------------------------
+
+
+def test_eviction_raises_session_evicted_from_wait_path():
+    """Regression (the old behavior): eviction set a silent flag and the
+    in-flight request vanished — wait loops spun forever. Now the wait
+    path (poll / take_reply) raises the typed SessionEvicted naming the
+    dropped request."""
+    small = ConfigCluster(
+        journal_slot_count=64, lsm_batch_multiple=4, clients_max=2,
+    )
+    cluster = Cluster(replica_count=3, cluster=small)
+    c0 = cluster.add_client()
+    # put a request IN FLIGHT on c0, with its delivery held so the
+    # eviction (caused by register pressure) lands first
+    lc = LinkControl(cluster.network)
+    hold = lc.hold(src=c0.client_id)
+    c0.request(Operation.create_accounts, _accounts_batch())
+    assert c0.in_flight is not None
+    cluster.add_client()
+    cluster.add_client()  # clients_max=2: evicts c0 (oldest session)
+    assert c0.evicted
+    assert c0.in_flight is None  # dropped, not silently retried
+    with pytest.raises(SessionEvicted) as err:
+        c0.take_reply()  # the wait path surfaces it
+    assert err.value.request == 1
+    # the error is consumed by raising: a second poll is clean
+    c0.poll()
+    del hold
+    lc.clear()
+
+
+def test_eviction_while_idle_surfaces_once():
+    small = ConfigCluster(
+        journal_slot_count=64, lsm_batch_multiple=4, clients_max=2,
+    )
+    cluster = Cluster(replica_count=3, cluster=small)
+    c0 = cluster.add_client()
+    cluster.add_client()
+    cluster.add_client()
+    assert c0.evicted
+    with pytest.raises(SessionEvicted) as err:
+        c0.poll()
+    assert err.value.request is None  # idle: no request was harmed
+
+
+# ----------------------------------------------------------------------
+# satellite: stale busy strictly ignored
+# ----------------------------------------------------------------------
+
+
+def test_stale_busy_strictly_ignored():
+    """A busy reply for anything but the CURRENT in-flight request
+    (matched by request number AND operation) must change nothing: no
+    counter, no flag, no backoff scheduling."""
+    cluster = Cluster(replica_count=1)
+    m = Metrics()
+    c = Client(CID, cluster.network, 1, metrics=m)
+    _register(cluster, c)
+
+    def busy(request: int, operation: int) -> None:
+        h = Header(
+            command=int(Command.busy), client=CID,
+            request=request, operation=operation, replica=0,
+        )
+        h.set_checksum_body(b"")
+        h.set_checksum()
+        c._on_message(0, h.to_bytes())
+
+    c.request(Operation.create_accounts, _accounts_batch())
+    # wrong request number; right number but wrong operation; and one
+    # with nothing in flight below — all strictly ignored
+    busy(c.request_number + 1, int(Operation.create_accounts))
+    busy(c.request_number, int(Operation.create_transfers))
+    assert c.busy_replies == 0 and not c.busy
+    assert m.counter("client.busy_sheds").value == 0
+    # the real one counts exactly once
+    busy(c.request_number, int(Operation.create_accounts))
+    assert c.busy_replies == 1 and c.busy
+    cluster.network.run()
+    c.take_reply()
+    # late duplicate busy after the reply: in_flight is None -> ignored
+    busy(c.request_number, int(Operation.create_accounts))
+    assert c.busy_replies == 1 and not c.busy
+    assert m.counter("client.busy_sheds").value == 1
+
+
+# ----------------------------------------------------------------------
+# timeout -> re-target -> duplicate-reply dedup
+# ----------------------------------------------------------------------
+
+
+def test_timeout_retargets_round_robin_and_dedups_duplicate_replies():
+    cluster = Cluster(replica_count=3)
+    m = Metrics()
+    c = Client(CID, cluster.network, 3, metrics=m,
+               request_timeout_ticks=4, max_backoff_exponent=1)
+    _register(cluster, c)
+    lc = LinkControl(cluster.network)
+    lc.hold(src=CID, dst=0, count=1)  # the first send is captured
+    body = _accounts_batch()
+    c.request(Operation.create_accounts, body)
+    commit_before = cluster.replicas[0].commit_min
+    # tick until the retry ladder walks the cluster back to the primary:
+    # fire 1 -> replica 1 (dropped: not primary), fire 2 -> replica 2
+    # (dropped), fire 3 -> replica 0 (served)
+    for _ in range(80):
+        c.tick()
+        cluster.network.run()
+        if c.reply is not None:
+            break
+    assert c.reply is not None
+    assert m.counter("client.timeouts").value >= 3
+    assert m.counter("client.retargets").value >= 2
+    # the HELD original now arrives twice (delayed + duplicated): the
+    # replica dedups via its client table and resends the cached reply;
+    # the client ignores both as stale
+    c.take_reply()
+    lc.clear()
+    lc.release(duplicate=2)
+    cluster.network.run()
+    assert cluster.replicas[0].commit_min == commit_before + 1
+    assert c.reply is None  # nothing awaited: duplicates dropped
+    assert m.counter("client.stale_replies").value >= 1
+
+
+# ----------------------------------------------------------------------
+# busy backoff: distinct ladder, runtime-driven resend
+# ----------------------------------------------------------------------
+
+
+def test_busy_backoff_resends_without_driver_and_loss_ladder_stays_cold():
+    from tigerbeetle_tpu.ingress import IngressGateway
+
+    cluster = Cluster(replica_count=1)
+    m = Metrics()
+    r = cluster.replicas[0]
+    gw = IngressGateway(cluster.network, r)
+    gw.install()
+    c = Client(CID, cluster.network, 1, metrics=m,
+               request_timeout_ticks=50)
+    _register(cluster, c)
+
+    orig = r.ingress_occupancy
+    r.ingress_occupancy = lambda: (99, 8)  # saturated: shed everything
+    gw.regulator.drain()
+    c.request(Operation.create_accounts, _accounts_batch())
+    cluster.network.run()
+    assert c.busy and c.busy_replies == 1
+    # a few sustained shed rounds: each runtime resend is answered busy
+    for _ in range(30):
+        c.tick()
+        cluster.network.run()
+    assert c.busy_replies >= 2  # the runtime resent into the shed wall
+    # capacity returns: the next runtime resend is admitted and commits
+    r.ingress_occupancy = orig
+    gw.regulator.drain()
+    for _ in range(80):
+        c.tick()
+        cluster.network.run()
+        if c.reply is not None:
+            break
+    _h, body = c.take_reply()
+    assert body == b""
+    # DISTINCT ladders: every retry rode the busy (decorrelated) path;
+    # the loss timeout never fired on top of it
+    assert m.counter("client.busy_sheds").value == c.busy_replies
+    assert m.counter("client.timeouts").value == 0
+    gw.uninstall()
+
+
+# ----------------------------------------------------------------------
+# ping/pong view discovery while idle
+# ----------------------------------------------------------------------
+
+
+def test_idle_ping_discovers_view_change():
+    cluster = Cluster(replica_count=3)
+    m = Metrics()
+    c = Client(CID, cluster.network, 3, metrics=m, ping_ticks=5)
+    _register(cluster, c)
+    assert c.view == 0 and c.primary_index == 0
+    # primary crashes; the backups elect view 1 while the client idles
+    cluster.detach_replica(0)
+    cluster.run_ticks(120)
+    assert cluster.replicas[1].status == "normal"
+    new_view = cluster.replicas[1].view
+    assert new_view > 0
+    for _ in range(30):
+        c.tick()
+        cluster.network.run()
+        if c.view == new_view:
+            break
+    assert c.view == new_view  # learned from pong_client, no request sent
+    assert c.primary_index == new_view % 3
+    assert m.counter("client.pings").value >= 1
+    assert m.counter("client.pongs").value >= 1
+
+
+# ----------------------------------------------------------------------
+# per-request deadline -> typed RequestTimeout
+# ----------------------------------------------------------------------
+
+
+def test_deadline_surfaces_request_timeout_and_session_survives():
+    cluster = Cluster(replica_count=1)
+    m = Metrics()
+    c = Client(CID, cluster.network, 1, metrics=m,
+               request_timeout_ticks=3, deadline_ticks=10)
+    _register(cluster, c)
+    lc = LinkControl(cluster.network)
+    lc.drop(src=CID, dst=0)  # blackhole: every send and retry lost
+    c.request(Operation.create_accounts, _accounts_batch())
+    for _ in range(12):
+        c.tick()
+    with pytest.raises(RequestTimeout) as err:
+        c.poll()
+    assert err.value.request == 1
+    assert c.in_flight is None
+    assert m.counter("client.deadline_timeouts").value == 1
+    # the session is still usable once the fault heals
+    lc.clear()
+    c.request(Operation.create_accounts, _accounts_batch(seed=9))
+    cluster.network.run()
+    _h, body = c.take_reply()
+    assert body == b""
+
+
+# ----------------------------------------------------------------------
+# eviction -> automatic re-registration
+# ----------------------------------------------------------------------
+
+
+def test_evicted_client_auto_reregisters_and_resumes():
+    small = ConfigCluster(
+        journal_slot_count=64, lsm_batch_multiple=4, clients_max=2,
+    )
+    cluster = Cluster(replica_count=3, cluster=small)
+    m = Metrics()
+    c0 = Client(CID, cluster.network, 3, metrics=m, auto_reregister=True)
+    _register(cluster, c0)
+    old_session = c0.session
+    cluster.add_client()
+    cluster.add_client()  # evicts c0
+    assert c0.evicted
+    # idle eviction + auto re-register: no error surfaces, the next
+    # tick re-registers a FRESH session
+    for _ in range(10):
+        c0.tick()
+        cluster.network.run()
+        if c0.reply is not None:
+            c0.take_reply()
+        if c0.session != 0 and not c0.evicted:
+            break
+    assert c0.session != 0 and c0.session != old_session
+    assert m.counter("client.reregisters").value == 1
+    # ...and the session serves requests again
+    c0.request(Operation.create_accounts, _accounts_batch(seed=11))
+    cluster.network.run()
+    _h, body = c0.take_reply()
+    assert body == b""
+
+
+def test_timeout_jitter_is_deterministic_per_client():
+    import random
+
+    rng_a = random.Random(1234)
+    rng_b = random.Random(1234)
+    ta = Timeout(30, rng_a)
+    tb = Timeout(30, rng_b)
+    seq_a = []
+    seq_b = []
+    for t, seq in ((ta, seq_a), (tb, seq_b)):
+        t.start()
+        seq.append(t.duration)
+        for _ in range(5):
+            t.backoff()
+            seq.append(t.duration)
+    assert seq_a == seq_b
+    assert seq_a[-1] <= 30 * 16 * 1.5 + 1  # capped ladder (+<=50% jitter)
+
+
+def test_wall_ticker_bounds_post_stall_burst():
+    class _N:
+        def attach(self, *_a):
+            pass
+
+        def send(self, *_a):
+            pass
+
+    c = Client(3, _N(), 1)
+    w = WallTicker(c, tick_s=0.01, max_burst=8)
+    w.advance(0.0)
+    w.advance(10.0)  # a 10s driver stall is NOT 1000 retries
+    assert c.ticks == 8
+
+
+# ----------------------------------------------------------------------
+# the seeded simulator matrix: every transition under the fault mix,
+# byte-identical per seed
+# ----------------------------------------------------------------------
+
+
+def _run_sim(seed: int, **kw):
+    from tigerbeetle_tpu.testing.simulator import Simulator
+
+    sim = Simulator(seed, **kw)
+    out = sim.run()
+    return out, sim.histories
+
+
+MATRIX = {
+    # SIGKILL-the-primary with requests in flight: timeout -> re-target
+    # -> duplicate-reply dedup carries the clients through failover
+    "primary_crash": dict(
+        ticks=700, primary_crash_probability=0.004, n_clients=3,
+    ),
+    # client frames dropped AND duplicated at high rate (requests,
+    # replies, busy, evictions all affected)
+    "client_frame_chaos": dict(
+        ticks=600,
+        options_kw=dict(
+            client_loss_probability=0.15, client_replay_probability=0.15,
+        ),
+    ),
+    # clock-skewed timeout firing: per-client fast/slow runtime clocks
+    "clock_skew": dict(ticks=600, client_tick_skew=True, n_clients=4),
+    # sustained shed: every replica gateway-fronted, a register storm on
+    # top, busy backoff carries the fleet through admission
+    "busy_shed_storm": dict(
+        ticks=700, ingress_gateway=True, storm_clients=12, n_clients=3,
+    ),
+    # eviction churn: a 2-session client table under 3 auto-re-
+    # registering clients — evict -> re-register -> resume, forever
+    "evict_reregister": dict(
+        ticks=600, n_clients=3, client_auto_reregister=True,
+        cluster=ConfigCluster(
+            journal_slot_count=64, lsm_batch_multiple=4, clients_max=2,
+        ),
+    ),
+    # per-request deadlines under loss: RequestTimeout surfaces, the
+    # slot retries with fresh work, histories stay linear
+    "deadlines": dict(
+        ticks=600, client_deadline_ticks=300, n_clients=3,
+    ),
+}
+
+
+@pytest.mark.parametrize("case", sorted(MATRIX))
+def test_client_runtime_simulator_matrix(case):
+    from tigerbeetle_tpu.testing.packet_simulator import (
+        PacketSimulatorOptions,
+    )
+
+    kw = dict(MATRIX[case])
+    opts_kw = kw.pop("options_kw", None)
+    if opts_kw is not None:
+        kw["options"] = PacketSimulatorOptions(
+            packet_loss_probability=0.02,
+            packet_replay_probability=0.02,
+            partition_probability=0.005,
+            **opts_kw,
+        )
+    seed = 1009
+    a_out, a_hist = _run_sim(seed, **kw)
+    if opts_kw is not None:
+        kw["options"] = PacketSimulatorOptions(
+            packet_loss_probability=0.02,
+            packet_replay_probability=0.02,
+            partition_probability=0.005,
+            **opts_kw,
+        )
+    b_out, b_hist = _run_sim(seed, **kw)
+    # byte-identical per seed: the whole committed history, not just
+    # the summary (bodies included)
+    assert a_hist == b_hist
+    assert a_out == b_out
+    assert a_out["committed_ops"] > 5
+    # the case-specific transition actually fired
+    if case == "primary_crash":
+        assert a_out["primary_crashes"] >= 1
+    if case == "evict_reregister":
+        assert a_out["client_evictions"] >= 1

@@ -369,6 +369,14 @@ def test_evicted_client_request_rejected():
     cluster.add_client()
     cluster.add_client()  # evicts c0
     assert c0.evicted
+    # the eviction surfaces as a typed error from the wait path; a driver
+    # that insists on reusing the dead session consumes it first
+    import pytest
+
+    from tigerbeetle_tpu.vsr.client import SessionEvicted
+
+    with pytest.raises(SessionEvicted):
+        c0.poll()
     commit = cluster.replicas[0].commit_min
     gen = WorkloadGenerator(72)
     op, events = gen.gen_accounts_batch(8)

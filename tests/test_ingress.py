@@ -531,6 +531,7 @@ def test_conn_close_drops_gateway_sessions_only_for_that_conn():
 
     class _FakeReplica:
         replica = 0
+        is_primary = True  # the gateway only admits on the primary
 
         def __init__(self, bus):
             from tigerbeetle_tpu.metrics import Metrics

@@ -287,6 +287,33 @@ def test_trace_and_inspect_metric_names_cataloged():
         assert help_
 
 
+def test_client_runtime_and_chaos_metric_names_cataloged():
+    """Name-coverage drift guard for the client runtime + live chaos
+    harness: every counter a Client binds (the pinned name list the
+    runtime exports) and every chaos.*/bus reconnect name the harness
+    emits must be CATALOG'd — and the binding itself must stay in sync
+    with the pinned list (a renamed counter fails here, not in prod)."""
+    from tigerbeetle_tpu.io.network import InProcessNetwork
+    from tigerbeetle_tpu.metrics import CATALOG, Metrics
+    from tigerbeetle_tpu.vsr.client import CLIENT_METRIC_NAMES, Client
+
+    for name in CLIENT_METRIC_NAMES:
+        assert name in CATALOG, name
+        kind, _unit, help_ = CATALOG[name]
+        assert kind == "counter" and help_
+    # the runtime's actual bindings == the pinned list
+    m = Metrics()
+    Client(0xC0, InProcessNetwork(), 1, metrics=m)
+    bound = {n for n in m.snapshot()["counters"] if n.startswith("client.")}
+    assert bound == set(CLIENT_METRIC_NAMES)
+    for name in ("chaos.kills", "chaos.restarts", "chaos.gray_stops",
+                 "chaos.conn_resets", "bus.reconnects",
+                 "bus.dial_failures", "ingress.passthrough_backup"):
+        assert name in CATALOG, name
+        assert CATALOG[name][0] == "counter"
+    assert CATALOG["chaos.recovery_ms"][0] == "histogram"
+
+
 # -- deterministic simulator tracer ------------------------------------
 
 

@@ -98,7 +98,11 @@ def test_simulation_grid_zone_faults_heal():
     # slow) device-backend run and the fault injector finds targets
     stats = run_simulation(
         23,
-        ticks=300,
+        # 450 ticks: the client runtime's jittered retry ladder paces
+        # this seed a little slower than the old flat resend cadence —
+        # 300 ticks left it one committed batch short of the first spill
+        # (no acquired forest blocks = no fault targets)
+        ticks=450,
         backend_factory=None,  # DeviceLedger with forest (spill active)
         n_clients=1,
         client_batch=24,

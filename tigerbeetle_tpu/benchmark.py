@@ -97,14 +97,18 @@ def _post_body(pend_body: bytes, start_id: int) -> bytes:
 
 class _BenchClient:
     """One session: its own TCP connection + vsr Client, one request in
-    flight, per-batch latency recorded."""
+    flight, per-batch latency recorded. Retries belong to the client
+    RUNTIME (timeout/backoff state machine, vsr/client.py): the driver
+    maps wall time onto its ticks and otherwise only pumps."""
 
     def __init__(self, client_id: int, port: int):
         from tigerbeetle_tpu.io.message_bus import TCPMessageBus
-        from tigerbeetle_tpu.vsr.client import Client
+        from tigerbeetle_tpu.vsr.client import Client, WallTicker
 
         self.bus = TCPMessageBus([("127.0.0.1", port)], client_id)
         self.client = Client(client_id, self.bus, replica_count=1)
+        # 0.1s ticks x 30-tick base = first retry ~3s, exponential after
+        self.ticker = WallTicker(self.client, tick_s=0.1)
         self.sent_at = 0.0
         self.latencies_ms: list[float] = []
         self.replies: list[bytes] = []
@@ -113,16 +117,14 @@ class _BenchClient:
         self.bus.pump(timeout=0.0)
 
     def wait_reply(self, deadline_s: float = 120.0) -> tuple:
-        t0 = last_send = time.monotonic()
-        while self.client.reply is None:
+        t0 = time.monotonic()
+        while not self.client.done:
             self.pump()
             now = time.monotonic()
             if now - t0 > deadline_s:
                 raise TimeoutError("benchmark client: no reply")
-            if now - last_send > 5.0 and self.client.in_flight is not None:
-                self.client.resend()  # request/reply lost: retransmit
-                last_send = now
-            if self.client.reply is None:
+            self.ticker.advance(now)  # the runtime owns retransmits
+            if not self.client.done:
                 time.sleep(0.0001)
         return self.client.take_reply()
 
@@ -586,7 +588,6 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
             inflight[s.client.client_id] = time.monotonic()
     deadline = t_start + max(600.0, n_transfers / 1000)
     done_batches = 0
-    resent: dict[int, float] = {}
     while inflight:
         progressed = False
         for s, q in zip(sessions, per_session):
@@ -595,14 +596,9 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
                 continue
             s.pump()
             if s.client.reply is None:
-                now = time.monotonic()
-                if (
-                    now - inflight[cid] > 5.0
-                    and now - resent.get(cid, 0.0) > 5.0
-                    and s.client.in_flight is not None
-                ):
-                    s.client.resend()  # lost under backpressure: retry
-                    resent[cid] = now
+                # a loss under backpressure retransmits via the client
+                # runtime's own timeout ladder
+                s.ticker.advance(time.monotonic())
                 continue
             _h, body = s.client.take_reply()
             lat_ms.append(
@@ -656,43 +652,37 @@ def _drive(proc, port, n_accounts, n_transfers, batch, clients,
 
 class _MuxSession:
     """One logical session multiplexed over a shared (demux) bus
-    connection: a vsr Client plus the driver's retry/backoff state."""
+    connection, driven by the client RUNTIME: busy sheds back off on the
+    decorrelated ladder, losses retransmit on the timeout ladder — the
+    driver only advances the ticker and harvests replies."""
 
-    __slots__ = ("client", "sent_at", "next_send", "backoff_s", "events")
+    __slots__ = ("client", "ticker", "sent_at", "events")
 
     def __init__(self, client_id: int, bus):
-        from tigerbeetle_tpu.vsr.client import Client
+        from tigerbeetle_tpu.vsr.client import Client, WallTicker
 
-        self.client = Client(client_id, bus, replica_count=1)
+        # 5ms ticks: busy retries land at 10-320ms (decorrelated), the
+        # loss ladder starts at 200ms (40 ticks) and caps at 4x
+        self.client = Client(
+            client_id, bus, replica_count=1,
+            request_timeout_ticks=40, max_backoff_exponent=2,
+            ping_ticks=0,  # 10k idle sessions must not ping-storm
+        )
+        self.ticker = WallTicker(self.client, tick_s=0.005)
         self.sent_at = 0.0
-        self.next_send = 0.0  # busy backoff: no resend before this
-        self.backoff_s = 0.0
         self.events = 0  # events this session has in flight
 
-    def poll(self, now: float, retry_s: float = 5.0) -> bool:
+    def poll(self, now: float) -> bool:
         """Drive one in-flight request: True once its reply landed.
-        A busy reply resends the SAME bytes after exponential backoff
-        (the shed/retry contract); a silent loss retransmits on the
-        plain retry timeout."""
+        Retry cadence lives in the Client's runtime config now
+        (request_timeout_ticks), not here."""
         c = self.client
-        if c.reply is not None:
+        if c.done:
             return True
         if c.in_flight is None:
             return False
-        if c.busy:
-            if self.backoff_s == 0.0:
-                self.backoff_s = 0.001
-            self.next_send = max(self.next_send, now + self.backoff_s)
-            self.backoff_s = min(self.backoff_s * 2, 0.05)
-            c.busy = False  # consumed; the resend below re-arms it
-        if self.next_send and now >= self.next_send:
-            c.resend()
-            self.next_send = 0.0
-            self.sent_at = now
-        elif not self.next_send and now - self.sent_at > retry_s:
-            c.resend()
-            self.sent_at = now
-        return False
+        self.ticker.advance(now)
+        return c.done
 
 
 def run_ingress_sessions(
@@ -899,8 +889,6 @@ def run_ingress_sessions(
                     s.events = len(body) // 128
                     s.client.request(Operation.create_transfers, body)
                     s.sent_at = now
-                    s.backoff_s = 0.0
-                    s.next_send = 0.0
                     inflight[s.client.client_id] = s
                 if bg_iter is not None and bodies:
                     scanned = 0  # bounded: never spin hunting an idle session
@@ -917,8 +905,6 @@ def run_ingress_sessions(
                             Operation.create_transfers, transfer_body(1)
                         )
                         s.sent_at = now
-                        s.backoff_s = 0.0
-                        s.next_send = 0.0
                         bg_inflight.append(s)
                 pump_all()
                 for cid in list(inflight):
@@ -957,7 +943,6 @@ def run_ingress_sessions(
                 Operation.create_accounts, _accounts_body(next_acct, k)
             )
             s0.sent_at = time.monotonic()
-            s0.next_send = 0.0
             t_acct = time.monotonic()
             while not s0.poll(time.monotonic()):
                 pump_all()
@@ -1021,7 +1006,6 @@ def run_ingress_sessions(
             ids = list(range(1 + i, 1 + min(i + 8000, n_accounts)))
             s0.client.request(Operation.lookup_accounts, encode_ids(ids))
             s0.sent_at = time.monotonic()
-            s0.next_send = 0.0
             t0 = time.monotonic()
             while not s0.poll(time.monotonic()):
                 pump_all()

@@ -164,6 +164,33 @@ class InspectArgs:
 
 
 @dataclasses.dataclass
+class ChaosArgs:
+    """Live-cluster chaos run (testing/chaos.py): spawn a real N-replica
+    TCP cluster + a multiplexed client fleet on the fault-tolerant
+    client runtime, inject live faults (SIGKILL/restart, SIGSTOP gray
+    failure, connection resets, a WAL disk-fault flip on restart), and
+    verify zero lost / zero duplicated transfers (client replies vs CDC
+    stream vs wire conservation, dual-mode hash-log parity), reporting
+    time-to-first-commit-after-kill."""
+
+    sessions: int = 64
+    conns: int = 4
+    accounts: int = 128
+    events_per_batch: int = 16
+    batches_per_session: int = 6
+    replicas: int = 3
+    backend: str = "native"
+    faults: str = "kill_primary"  # comma list, see CHAOS_ACTIONS
+    restart_after_s: float = 2.0
+    gray_s: float = 3.0
+    disk_fault: bool = True  # flip WAL bytes on the first restart
+    ingress: bool = False  # front every replica with the gateway
+    seed: int = 1
+    deadline_s: float = 600.0
+    json: str = ""  # write the full report here too
+
+
+@dataclasses.dataclass
 class CdcArgs:
     """Offline change-stream tool: replay an AOF into a sink, resuming
     from (and advancing) a durable consumer cursor. The disaster-recovery
@@ -703,6 +730,41 @@ def cmd_start(args) -> int:
             )
 
 
+def cmd_chaos(args) -> int:
+    import json as _json
+
+    from tigerbeetle_tpu.testing.chaos import CHAOS_ACTIONS, run_chaos
+
+    faults = tuple(f for f in args.faults.split(",") if f)
+    for f in faults:
+        if f not in CHAOS_ACTIONS:
+            flags.fatal(
+                f"unknown fault {f!r} ({' | '.join(CHAOS_ACTIONS)})"
+            )
+
+    def log(*a):
+        print("[chaos]", *a, file=sys.stderr, flush=True)
+
+    report = run_chaos(
+        n_sessions=args.sessions, conns=args.conns,
+        n_accounts=args.accounts,
+        events_per_batch=args.events_per_batch,
+        batches_per_session=args.batches_per_session,
+        replica_count=args.replicas, backend=args.backend,
+        faults=faults, restart_after_s=args.restart_after_s,
+        gray_s=args.gray_s, disk_fault_on_restart=args.disk_fault,
+        ingress=args.ingress, seed=args.seed, deadline_s=args.deadline_s,
+        jax_platform=None,  # the CLI inherits the ambient platform
+        log=log,
+    )
+    if args.json:
+        with open(args.json, "w") as f:
+            _json.dump(report, f, indent=1, sort_keys=True)
+    print(_json.dumps(report, indent=1, sort_keys=True))
+    ok = report["lost_events"] == 0 and report["conservation_ok"]
+    return 0 if ok else 1
+
+
 def cmd_cdc(args) -> int:
     """Replay the AOF's change stream into a sink from the consumer's
     cursor. One shot: runs to the end of the log (or --limit), acks the
@@ -873,6 +935,7 @@ commands:
   repl     interactive client (alias: client)
   cdc      replay an AOF's change stream into a sink (cursor resume)
   inspect  decode a data file offline / read a live server's stats
+  chaos    live-cluster chaos run (kill/gray/reset faults + verification)
 """
 
 COMMANDS = {
@@ -882,6 +945,7 @@ COMMANDS = {
     "client": (ReplArgs, cmd_repl),
     "cdc": (CdcArgs, cmd_cdc),
     "inspect": (InspectArgs, cmd_inspect),
+    "chaos": (ChaosArgs, cmd_chaos),
 }
 
 

@@ -63,6 +63,7 @@ class IngressGateway:
         self._c_shed = m.counter("ingress.shed")
         self._c_shed_sessions = m.counter("ingress.shed_sessions")
         self._c_retransmits = m.counter("ingress.retransmits")
+        self._c_passthrough = m.counter("ingress.passthrough_backup")
         self._g_sessions = m.gauge("ingress.sessions")
 
     # -- install / uninstall (the handler-wrap seam) --
@@ -126,6 +127,20 @@ class IngressGateway:
     def on_frame(self, src, frame: bytes) -> None:
         if len(frame) < HEADER_SIZE or frame[_CMD_OFF] != _CMD_REQUEST:
             self._inner(src, frame)  # consensus/repair/sync: pass through
+            return
+        if not self.replica.is_primary:
+            # Shed/busy interplay with client failover: the runtime's
+            # timeout RE-TARGETS requests round-robin, so backups see a
+            # spray of requests they will drop (not primary). Admitting
+            # them would burn credits and grow this gateway's session
+            # table from traffic it never serves; SHEDDING them would be
+            # worse — a busy reply stamped with a stale view would tell
+            # the client "alive, back off" about a replica that cannot
+            # serve it, stalling failover behind the busy ladder. Pass
+            # through untouched: the replica drops it, the client's
+            # timeout walks on to the primary.
+            self._c_passthrough.add()
+            self._inner(src, frame)
             return
         cid = int.from_bytes(
             frame[_CLIENT_OFF : _CLIENT_OFF + 16], "little"

@@ -40,6 +40,15 @@ Ingress extensions (tigerbeetle_tpu/ingress — the 10k-session front door):
   its cap (open socket, never reads) accumulates strikes and is
   disconnected after `wedged_strikes_max` consecutive refusals —
   replica links are exempt (VSR owns their retry discipline).
+- **Reconnect with backoff**: a lost or refused dial arms a per-replica
+  backoff (50ms doubling to 2s, reset on success); sends inside the
+  window return "unreachable" without burning a dial, the first send
+  after it re-dials. Reconnection is LAZY — the retry that triggers the
+  send is the client runtime's timeout (vsr/client.py) or VSR's own
+  retransmits, so a restarted replica's clients re-attach without any
+  driver code. Multiplexed (demux) sessions re-alias on the new
+  connection automatically: the server re-learns each session's routing
+  from the first request (or client ping) frame it sends there.
 """
 
 from __future__ import annotations
@@ -133,6 +142,8 @@ class TCPMessageBus(Network):
         self._c_flushes = m.counter("bus.flushes")
         self._c_tx_bytes = m.counter("bus.tx_bytes")
         self._c_frames = m.counter("bus.frames")
+        self._c_reconnects = m.counter("bus.reconnects")
+        self._c_dial_failures = m.counter("bus.dial_failures")
 
     def __init__(
         self,
@@ -185,6 +196,15 @@ class TCPMessageBus(Network):
         # ingress gateway seam: notified of session aliasing and closes
         # (None when no gateway is installed — the pre-ingress behavior)
         self.ingress = None
+        # Reconnect-with-backoff state, per dialed replica: a failed or
+        # refused dial must not hot-loop SYNs at a dead peer (every send
+        # would otherwise pay a socket+connect), and the window doubles
+        # while the peer stays dead. replica -> [retry_at_monotonic,
+        # current_delay_s]; absent = dial freely. `_was_connected` marks
+        # replicas we reached at least once, so a successful re-dial
+        # counts into bus.reconnects (first dials don't).
+        self._dial_backoff: dict[int, list] = {}
+        self._was_connected: set[int] = set()
         self.listener: socket.socket | None = None
         if listen:
             host, port = addresses[own_address]
@@ -285,21 +305,48 @@ class TCPMessageBus(Network):
 
     # -- connections --
 
+    DIAL_BACKOFF_MIN = 0.05  # first retry window after a failed dial
+    DIAL_BACKOFF_MAX = 2.0  # ceiling while the peer stays dead
+
+    def _dial_fail(self, replica: int) -> None:
+        """A dial was refused/errored: arm (or double) the backoff window
+        so sends stop paying a socket+SYN per attempt at a dead peer."""
+        self._c_dial_failures.add()
+        b = self._dial_backoff.get(replica)
+        delay = self.DIAL_BACKOFF_MIN if b is None else min(
+            self.DIAL_BACKOFF_MAX, b[1] * 2
+        )
+        self._dial_backoff[replica] = [_time.monotonic() + delay, delay]
+
+    def _dial_ok(self, replica: int) -> None:
+        self._dial_backoff.pop(replica, None)
+        if replica in self._was_connected:
+            self._c_reconnects.add()
+        else:
+            self._was_connected.add(replica)
+
     def _connect(self, replica: int) -> _Conn | None:
         # NON-BLOCKING dial: a blocked peer must never stall the event loop
         # (consensus for the live quorum would freeze for the TCP timeout).
+        b = self._dial_backoff.get(replica)
+        if b is not None and _time.monotonic() < b[0]:
+            return None  # inside the backoff window: don't burn a dial
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setblocking(False)
         try:
             rc = s.connect_ex(self.addresses[replica])
         except OSError:
             s.close()
+            self._dial_fail(replica)
             return None
         if rc not in (0, errno.EINPROGRESS, errno.EWOULDBLOCK):
             s.close()
+            self._dial_fail(replica)
             return None
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn = _Conn(s, peer=replica, connected=(rc == 0))
+        if rc == 0:
+            self._dial_ok(replica)
         self.conns[replica] = conn
         self._links[conn] = None
         self.sel.register(
@@ -402,8 +449,12 @@ class TCPMessageBus(Network):
                 # pending dial resolved: success or failure
                 err = conn.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
                 if err != 0:
+                    if conn.peer is not None:
+                        self._dial_fail(conn.peer)
                     self._close(conn)
                     continue
+                if conn.peer is not None:
+                    self._dial_ok(conn.peer)
                 conn.connected = True
                 self.sel.modify(
                     conn.sock, selectors.EVENT_READ, ("conn", conn)
@@ -522,13 +573,19 @@ class TCPMessageBus(Network):
                 # Latest-wins (a reconnecting session's new connection
                 # takes over); the degenerate case — one session whose id
                 # IS the hello peer — is a no-op dict hit.
-                if frame[self._CMD_OFF] == _CMD_REQUEST:
+                if frame[self._CMD_OFF] in (_CMD_REQUEST, _CMD_PING_CLIENT):
                     cid = int.from_bytes(
                         frame[self._CLIENT_OFF : self._CLIENT_OFF + 16],
                         "little",
                     )
+                    # ping_client aliases too: an idle multiplexed session
+                    # whose connection died re-attaches with its first
+                    # ping — the pong must route over the NEW conn even
+                    # before the session's next request re-aliases it
                     if cid and self.conns.get(cid) is not conn:
                         self._alias(cid, conn)
+                    if frame[self._CMD_OFF] != _CMD_REQUEST:
+                        cid = 0  # pings don't anchor trace ids
                     if parse_traces is not None and cid:
                         # ingress: the trace id is ASSIGNED here, from
                         # the request's own (client, checksum) pair
@@ -568,6 +625,24 @@ class TCPMessageBus(Network):
             conn.roff = 0
         return n
 
+    def drop_connections(self) -> None:
+        """Fault-injection helper (chaos harness / tests): abruptly close
+        every live connection with SO_LINGER=0, so the peer observes a
+        RESET, not a graceful FIN. Recovery is the production path under
+        test: the next send re-dials (with backoff), sessions re-alias,
+        and the client runtime's timeouts retransmit what was in flight."""
+        import struct as _struct
+
+        for conn in list(self._links):
+            try:
+                conn.sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    _struct.pack("ii", 1, 0),
+                )
+            except OSError:
+                pass
+            self._close(conn)
+
     def _alias(self, cid: Address, conn: _Conn) -> None:
         old = self.conns.get(cid)
         if old is not None and old is not conn:
@@ -582,6 +657,7 @@ class TCPMessageBus(Network):
 # pin the offsets against the Header layout so they can never drift
 _CMD_REQUEST = int(Command.request)
 _CMD_REPLY = int(Command.reply)
+_CMD_PING_CLIENT = int(Command.ping_client)
 _pin = Header(
     size=0x0BADF00D, client=0x0CAFE, context=0x0C0FFEE, request=0x0D15EA5E,
     command=int(Command.request), operation=0x42,

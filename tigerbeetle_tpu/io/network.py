@@ -74,3 +74,67 @@ class InProcessNetwork(Network):
             if n >= limit:
                 raise RuntimeError("network did not quiesce (livelock?)")
         return n
+
+
+class LinkControl:
+    """Scripted, fully deterministic link faults over InProcessNetwork
+    (the client-runtime tests' fault dial): drop or HOLD messages
+    matching a (src, dst) pattern — held messages are captured in order
+    and re-injected by release(), modeling a delayed/duplicated delivery
+    with an exact interleaving (no randomness; the seeded chaos lives in
+    PacketSimulator)."""
+
+    def __init__(self, network: InProcessNetwork):
+        self.network = network
+        self.rules: list[dict] = []
+        self.held: list[tuple[Address, Address, bytes]] = []
+        network.filters.append(self._filter)
+
+    def _match(self, rule: dict, src: Address, dst: Address) -> bool:
+        return (
+            (rule["src"] is None or rule["src"] == src)
+            and (rule["dst"] is None or rule["dst"] == dst)
+        )
+
+    def _filter(self, src: Address, dst: Address, data: bytes) -> bool:
+        for rule in self.rules:
+            if rule["remaining"] == 0 or not self._match(rule, src, dst):
+                continue
+            if rule["remaining"] > 0:
+                rule["remaining"] -= 1
+            if rule["mode"] == "hold":
+                self.held.append((src, dst, data))
+            return False
+        return True
+
+    def drop(self, src: Address | None = None, dst: Address | None = None,
+             count: int = -1) -> dict:
+        """Drop messages matching (src, dst); count<0 = until clear()."""
+        rule = {"src": src, "dst": dst, "mode": "drop", "remaining": count}
+        self.rules.append(rule)
+        return rule
+
+    def hold(self, src: Address | None = None, dst: Address | None = None,
+             count: int = -1) -> dict:
+        """Capture matching messages instead of delivering them; they
+        re-enter the queue (in capture order) at release()."""
+        rule = {"src": src, "dst": dst, "mode": "hold", "remaining": count}
+        self.rules.append(rule)
+        return rule
+
+    def clear(self) -> None:
+        self.rules.clear()
+
+    def release(self, duplicate: int = 1) -> int:
+        """Re-inject every held message `duplicate` times (1 = plain
+        delayed delivery; 2 = delayed + duplicated — the stale-frame
+        storms a healed link replays). Active rules still apply to the
+        released copies (clear() first for a clean heal). Returns
+        messages re-injected."""
+        held, self.held = self.held, []
+        n = 0
+        for src, dst, data in held:
+            for _ in range(duplicate):
+                self.network.queue.append((src, dst, data))
+                n += 1
+        return n

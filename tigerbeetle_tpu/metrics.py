@@ -367,6 +367,25 @@ CATALOG = {
     "bus.tx_bytes": ("counter", "bytes", "bytes written to sockets"),
     "bus.flushes": ("counter", "", "deferred-send flush passes"),
     "bus.pump_us": ("histogram", "us", "event-loop pump turns that dispatched frames"),
+    "bus.reconnects": ("counter", "conns", "successful re-dials to a previously reached replica"),
+    "bus.dial_failures": ("counter", "", "dials refused/errored (arms the reconnect backoff)"),
+    # client runtime (vsr/client.py tick state machine)
+    "client.timeouts": ("counter", "", "request timeouts fired (loss ladder)"),
+    "client.resends": ("counter", "", "request retransmissions (timeout, busy, legacy resend)"),
+    "client.retargets": ("counter", "", "timeout resends aimed off-primary (round-robin walk)"),
+    "client.busy_sheds": ("counter", "", "typed busy replies accepted for the in-flight request"),
+    "client.pings": ("counter", "", "idle ping_client rounds (view discovery)"),
+    "client.pongs": ("counter", "", "pong_client replies (view learned while idle)"),
+    "client.evictions": ("counter", "", "sessions evicted by the cluster"),
+    "client.reregisters": ("counter", "", "automatic post-eviction re-registrations"),
+    "client.deadline_timeouts": ("counter", "", "requests dropped at their per-request deadline"),
+    "client.stale_replies": ("counter", "", "duplicate/stale replies ignored (dedup)"),
+    # live chaos harness (testing/chaos.py)
+    "chaos.kills": ("counter", "", "replica processes SIGKILLed"),
+    "chaos.restarts": ("counter", "", "replica processes respawned"),
+    "chaos.gray_stops": ("counter", "", "SIGSTOP gray failures injected"),
+    "chaos.conn_resets": ("counter", "", "client connection reset storms injected"),
+    "chaos.recovery_ms": ("histogram", "ms", "fault to first client reply after it"),
     # server event loop (cli.py)
     "loop.busy_s": ("counter", "s", "event-loop busy wall time (pump+commit+flush)"),
     "loop.turns": ("counter", "", "busy event-loop turns"),
@@ -423,6 +442,9 @@ CATALOG = {
     "ingress.shed": ("counter", "requests", "requests answered with a typed busy reply"),
     "ingress.shed_sessions": ("counter", "requests", "new sessions shed at the gateway cap"),
     "ingress.retransmits": ("counter", "requests", "retransmits bypassing admission"),
+    "ingress.passthrough_backup": (
+        "counter", "requests", "requests passed through on a non-primary"
+    ),
     "ingress.accepts": ("counter", "conns", "connections taken by the accept-drain loop"),
     "ingress.shed_conn": ("counter", "sends", "sends refused at a per-connection queue cap"),
     "ingress.shed_pool": ("counter", "sends", "sends refused at the shared message-pool budget"),

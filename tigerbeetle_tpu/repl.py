@@ -30,7 +30,7 @@ from tigerbeetle_tpu.types import (
     Transfer,
     TransferFlags,
 )
-from tigerbeetle_tpu.vsr.client import Client
+from tigerbeetle_tpu.vsr.client import Client, WallTicker
 
 _ACCOUNT_FIELDS = {f.name for f in dataclasses.fields(Account)}
 _TRANSFER_FIELDS = {f.name for f in dataclasses.fields(Transfer)}
@@ -84,23 +84,23 @@ class Repl:
         self.addresses = addresses
         self.client_id = client_id or random.getrandbits(120) | (1 << 120)
         self.bus = TCPMessageBus(addresses, self.client_id, listen=False)
+        # 20ms ticks -> first retry at ~600ms, re-targeted round-robin
+        # across the cluster on the runtime's own ladder (an eviction or
+        # deadline surfaces as the typed error from take_reply)
         self.client = Client(self.client_id, self.bus, len(addresses),
-                             cluster_id)
+                             cluster_id, request_timeout_ticks=30,
+                             max_backoff_exponent=2)
+        self.ticker = WallTicker(self.client, tick_s=0.02)
 
     # -- request/response over the bus --
 
     def _await_reply(self, timeout: float = 10.0):
         deadline = time.monotonic() + timeout
-        resend_at = time.monotonic() + 1.0
         while time.monotonic() < deadline:
             self.bus.pump(timeout=0.02)
-            if self.client.reply is not None:
+            self.ticker.advance(time.monotonic())
+            if self.client.done:
                 return self.client.take_reply()
-            if self.client.evicted:
-                raise RuntimeError("session evicted")
-            if time.monotonic() > resend_at:
-                self.client.resend()
-                resend_at = time.monotonic() + 1.0
         raise TimeoutError("no reply from cluster")
 
     def connect(self) -> None:

@@ -1,0 +1,873 @@
+"""Live-cluster chaos harness: real processes, real sockets, real faults.
+
+Everything the in-process simulator proves under virtual time, this
+proves against the PRODUCTION stack: a real N-replica TCP cluster
+(`tigerbeetle_tpu start` processes) under a multiplexed client fleet
+driven purely by the fault-tolerant client runtime (vsr/client.py tick
+state machine — the harness only pumps buses and ticks clients; no
+hand-rolled retry anywhere), while faults land on live processes:
+
+- SIGKILL + restart of the primary and of backups (crash-failover);
+- SIGSTOP/SIGCONT gray failures: the process is alive, holds its
+  sockets, answers nothing — the failure mode timeouts exist for;
+- connection resets (SO_LINGER=0 closes): every client link dies at
+  once and must re-dial + re-alias without driver help;
+- a disk-fault flip on one replica's restart: WAL bytes corrupted while
+  the process is down, recovery must classify + repair from peers.
+
+Verification is end-to-end and three-way (the reference VOPR's
+liveness/safety checkers, over the wire):
+
+- zero LOST transfers: every batch a client submitted is acked (the
+  fleet drives until its whole queue drains; typed client errors
+  surface instead of hanging);
+- zero DUPLICATED transfers: wire conservation (debits_posted ==
+  credits_posted == acked events, each transfer moves amount=1) plus
+  the CDC stream's unique transfer ids and all-ok result codes — a
+  double-executed batch would surface as id-exists result codes;
+- CDC stream parity: replica 0 streams `--cdc-jsonl` with a durable
+  cursor across its own crashes; the deduped stream must carry exactly
+  the acked transfers;
+- hash-log parity (dual backend): each replica's graceful shutdown
+  verifies its device applier bit-exact against the native engine
+  (per-op hash-log rings name the first divergent op if any).
+
+The recovery metric is time-to-first-commit-after-kill: wall ms from
+the fault to the first client reply that lands afterwards (a reply
+requires a live primary — served fresh or from the replicated client
+table, either way the cluster re-formed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from tigerbeetle_tpu.benchmark import (
+    REPO,
+    _accounts_body,
+    _transfers_body,
+    free_port,
+    kill_process_group,
+)
+from tigerbeetle_tpu.constants import ConfigCluster
+from tigerbeetle_tpu.io.storage import Zone, ZoneLayout
+from tigerbeetle_tpu.metrics import Metrics
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.client import Client, WallTicker
+
+CHAOS_ACTIONS = (
+    "kill_primary", "kill_backup", "gray_primary", "reset_conns",
+)
+
+
+def inject_wal_fault(path: str, cluster_cfg: ConfigCluster,
+                     rng: random.Random, slots: int = 4) -> list[int]:
+    """Flip bytes inside a few WAL prepare slots of a DOWN replica's data
+    file (the disk-fault restart flip): XOR 0xFF over 64 bytes mid-body,
+    so whatever the slot held — a prepare or padding — reads back
+    corrupt. Recovery must classify the slots faulty and repair from
+    peers (never trust, never wedge). Returns the slots flipped."""
+    layout = ZoneLayout(cluster_cfg)
+    msg_max = cluster_cfg.message_size_max
+    hit = sorted(rng.sample(range(cluster_cfg.journal_slot_count), slots))
+    with open(path, "r+b") as f:
+        for slot in hit:
+            off = layout.offset(Zone.wal_prepares, slot * msg_max + 256)
+            f.seek(off)
+            buf = bytes(b ^ 0xFF for b in f.read(64))
+            f.seek(off)
+            f.write(buf)
+    return hit
+
+
+class ChaosServer:
+    """One replica process: spawn / SIGKILL / SIGSTOP / SIGCONT /
+    graceful terminate, stdout drained on a daemon thread with the
+    shutdown [stats] line captured per incarnation."""
+
+    def __init__(self, index: int, addresses: str, path: str, env: dict,
+                 backend: str, session_args: tuple, extra_args: tuple,
+                 log):
+        self.index = index
+        self.addresses = addresses
+        self.path = path
+        self.env = env
+        self.backend = backend
+        self.session_args = session_args
+        self.extra_args = extra_args
+        self.log = log
+        self.proc: subprocess.Popen | None = None
+        self.stats: dict = {}  # last incarnation's [stats] payload
+        self.ready = threading.Event()
+        self.spawns = 0
+        self.stopped = False  # SIGSTOPped (gray failure)
+
+    def spawn(self, wait: bool = True, boot_timeout_s: float = 300.0) -> None:
+        assert self.proc is None or self.proc.poll() is not None
+        self.spawns += 1
+        self.stats = {}
+        self.stopped = False
+        self.ready.clear()
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "tigerbeetle_tpu", "start",
+             "--addresses", self.addresses,
+             "--replica", str(self.index),
+             "--backend", self.backend,
+             *self.session_args, *self.extra_args, self.path],
+            cwd=REPO, env=self.env, start_new_session=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        stats, ready = self.stats, self.ready
+
+        def _boot_then_drain(pipe=self.proc.stdout, idx=self.index):
+            # boot phase (until "listening"), then drain until EOF: one
+            # thread per incarnation, so a mid-run RESTART never blocks
+            # the drive loop on a readline while the fleet needs pumping
+            for out in pipe:
+                line = out.rstrip()
+                if "listening" in line:
+                    ready.set()
+                elif line.startswith("[stats] "):
+                    try:
+                        stats.update(json.loads(line[8:]))
+                    except ValueError:
+                        pass
+                else:
+                    self.log(f"[r{idx}]", line)
+
+        threading.Thread(target=_boot_then_drain, daemon=True).start()
+        if wait:
+            if not self.ready.wait(boot_timeout_s):
+                raise TimeoutError(
+                    f"chaos replica {self.index} never reached listening"
+                )
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL the whole process group: no shutdown path runs (the
+        crash the WAL + replicated client table exist for)."""
+        assert self.alive
+        kill_process_group(self.proc)
+        self.proc.wait()
+
+    def sigstop(self) -> None:
+        """Gray failure: alive, sockets open, answering nothing."""
+        assert self.alive and not self.stopped
+        os.killpg(self.proc.pid, signal.SIGSTOP)
+        self.stopped = True
+
+    def sigcont(self) -> None:
+        if self.proc is not None and self.stopped:
+            try:
+                os.killpg(self.proc.pid, signal.SIGCONT)
+            except (ProcessLookupError, OSError):
+                pass
+            self.stopped = False
+
+    def terminate(self, timeout_s: float = 650.0) -> dict:
+        """Graceful SIGTERM: the server prints [stats] (dual mode runs
+        its device-parity verification inside it) and exits."""
+        if self.proc is None:
+            return self.stats
+        self.sigcont()
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                pass
+        # the drain thread sees EOF once the process exits; give it a
+        # beat to finish parsing the [stats] line it may still hold
+        for _ in range(50):
+            if self.stats:
+                break
+            time.sleep(0.1)
+        kill_process_group(self.proc)
+        return self.stats
+
+
+class _Session:
+    """One logical session: a runtime-driven Client plus its share of
+    the workload queue. NO retry logic lives here — backoff, re-target,
+    busy handling and failover are all Client.tick()."""
+
+    __slots__ = ("client", "ticker", "queue", "events_inflight", "acked",
+                 "issue_seq")
+
+    def __init__(self, client: Client, tick_s: float):
+        self.client = client
+        self.ticker = WallTicker(client, tick_s=tick_s)
+        self.queue: list[bytes] = []
+        self.events_inflight = 0
+        self.acked = 0
+        self.issue_seq = 0  # fleet._issue_seq when the batch was issued
+
+
+class ChaosFleet:
+    """n_sessions logical sessions multiplexed over `conns` demux TCP
+    buses against the cluster, all on the client runtime."""
+
+    CLIENT_BASE = 0xCA05_0000
+
+    def __init__(self, ports: list[int], n_sessions: int, conns: int,
+                 metrics: Metrics, tick_s: float = 0.01,
+                 request_timeout_ticks: int = 40):
+        from tigerbeetle_tpu.io.message_bus import TCPMessageBus
+
+        addresses = [("127.0.0.1", p) for p in ports]
+        self.replica_count = len(ports)
+        self.buses = [
+            TCPMessageBus(addresses, 0xCAFE_0000 + b, demux=True)
+            for b in range(conns)
+        ]
+        for b in self.buses:
+            b.metrics = metrics
+        self.sessions = [
+            _Session(
+                Client(
+                    self.CLIENT_BASE + i, self.buses[i % conns],
+                    replica_count=self.replica_count,
+                    request_timeout_ticks=request_timeout_ticks,
+                    # live failover wants a snappy capped ladder (400ms
+                    # base at 10ms ticks, 4x cap); the deeper default
+                    # ladder is for polite steady-state retries
+                    max_backoff_exponent=2,
+                    ping_ticks=200,
+                    metrics=metrics,
+                ),
+                tick_s,
+            )
+            for i in range(n_sessions)
+        ]
+        self.acked_events = 0
+        self.total_events = 0
+        self.max_op = 0  # highest committed op any reply named
+        self._h_recovery = metrics.histogram("chaos.recovery_ms", unit="ms")
+        self._issue_seq = 0  # requests issued (stamps _Session.issue_seq)
+        self.errors: list[str] = []
+        # (monotonic, events) per acked batch — the failover bench
+        # derives before/after-kill throughput windows from it
+        self.acked_timeline: list[tuple[float, int]] = []
+        # Recovery probe: armed at fault time, resolved by the first
+        # reply that PROVES post-fault service — a reply stamped with a
+        # view newer than the fault-time view (a new primary served or
+        # resent it), or a reply to a request ISSUED after the fault.
+        # A bare "next reply" would under-read the metric: bytes the
+        # dead primary wrote to a socket just before the SIGKILL are
+        # still delivered by TCP and would resolve the probe in ~1ms.
+        self._fault_at: float | None = None
+        self._fault_view = 0
+        self._fault_issue_seq = 0
+        self.recoveries_ms: list[float] = []
+
+    def pump(self) -> int:
+        n = 0
+        for b in self.buses:
+            n += b.pump(timeout=0.0)
+        return n
+
+    def mark_fault(self, now: float) -> None:
+        """Arm the time-to-first-commit-after-fault probe."""
+        self._fault_at = now
+        self._fault_view = self.view
+        self._fault_issue_seq = self._issue_seq
+
+    def step(self, now: float) -> int:
+        """One drive turn: pump, tick, harvest replies, feed queues.
+        Returns replies harvested (0 = idle turn, caller may sleep)."""
+        dispatched = self.pump()
+        harvested = 0
+        for s in self.sessions:
+            s.ticker.advance(now)
+            c = s.client
+            try:
+                c.poll()
+            except Exception as e:  # typed errors: record, never hang
+                self.errors.append(f"{type(e).__name__}: {e}")
+                s.events_inflight = 0
+            if c.reply is not None:
+                _h, body = c.take_reply()
+                self.max_op = max(self.max_op, _h.op)
+                if body != b"":
+                    self.errors.append(
+                        f"client {c.client_id:#x}: non-empty reply "
+                        f"({len(body)} bytes of result structs)"
+                    )
+                t = time.monotonic()
+                if self._fault_at is not None and (
+                    _h.view > self._fault_view
+                    or s.issue_seq > self._fault_issue_seq
+                ):
+                    ms = (t - self._fault_at) * 1e3
+                    self.recoveries_ms.append(ms)
+                    self._h_recovery.observe(ms)
+                    self._fault_at = None
+                self.acked_events += s.events_inflight
+                self.acked_timeline.append((t, s.events_inflight))
+                s.acked += s.events_inflight
+                s.events_inflight = 0
+                harvested += 1
+            if c.in_flight is None and c.session != 0 and s.queue:
+                body = s.queue.pop(0)
+                s.events_inflight = len(body) // 128
+                self._issue_seq += 1
+                s.issue_seq = self._issue_seq
+                c.request(Operation.create_transfers, body)
+        return harvested + dispatched
+
+    def outstanding(self) -> int:
+        return self.total_events - self.acked_events
+
+    @property
+    def view(self) -> int:
+        return max(s.client.view for s in self.sessions)
+
+    def register_all(self, deadline_s: float = 300.0,
+                     window: int = 64) -> float:
+        """Windowed registration storm: every register is a consensus op
+        against a bounded pipeline, so at most `window` are in flight
+        (the runtime's timeouts still cover any the replica dropped)."""
+        t0 = time.monotonic()
+        pending = deque(self.sessions)
+        active: list[_Session] = []
+        while pending or active:
+            now = time.monotonic()
+            if now - t0 > deadline_s:
+                raise TimeoutError(
+                    f"registration stalled: {len(pending)} pending "
+                    f"{len(active)} active"
+                )
+            while pending and len(active) < window:
+                s = pending.popleft()
+                s.client.register()
+                active.append(s)
+            n = self.pump()
+            still = []
+            for s in active:
+                s.ticker.advance(now)
+                s.client.poll()
+                if s.client.reply is not None:
+                    s.client.take_reply()
+                if s.client.session == 0:
+                    still.append(s)
+            active = still
+            if n == 0:
+                time.sleep(0.0005)
+        return time.monotonic() - t0
+
+    def execute(self, session: _Session, operation: Operation,
+                body: bytes, deadline_s: float = 120.0) -> bytes:
+        """One synchronous request through a session (setup/verification
+        traffic — the runtime still owns retries)."""
+        c = session.client
+        c.request(operation, body)
+        t0 = time.monotonic()
+        while not c.done:
+            now = time.monotonic()
+            if now - t0 > deadline_s:
+                raise TimeoutError(f"request stalled ({operation})")
+            if self.pump() == 0:
+                time.sleep(0.0005)
+            session.ticker.advance(now)
+        _h, reply = c.take_reply()
+        self.max_op = max(self.max_op, _h.op)
+        return reply
+
+    def close(self) -> None:
+        for b in self.buses:
+            try:
+                b.sel.close()
+            except Exception:
+                pass
+
+
+def _parse_cdc_stream(path: str) -> dict:
+    """Deduped view of the chaos run's CDC JSONL: at-least-once becomes
+    exactly-once by keeping each (op, ix) record's FIRST delivery (the
+    same dedup every consumer applies). A torn TRAILING line (SIGKILL
+    mid-write) is tolerated — only the tail can tear in an append-only
+    single-writer file; its op is unacked and redelivered."""
+    seen: set[tuple[int, int]] = set()
+    ids_seen: set[int] = set()
+    transfers_ok = 0
+    transfers_bad = 0
+    redelivered = 0
+    dup_ids = 0
+    lines = 0
+    with open(path) as f:
+        raw = f.read().splitlines()
+    for i, line in enumerate(raw):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            if i == len(raw) - 1:
+                break
+            raise
+        lines += 1
+        if rec.get("kind") != "transfer":
+            continue
+        key = (rec["op"], rec.get("ix", 0))
+        if key in seen:
+            redelivered += 1
+            continue
+        seen.add(key)
+        tid = rec.get("id")
+        if tid in ids_seen:
+            # the same transfer id committed under TWO ops: a request
+            # executed twice — exactly the bug class the harness hunts
+            dup_ids += 1
+            continue
+        ids_seen.add(tid)
+        if rec.get("result") == 0:
+            transfers_ok += 1
+        else:
+            transfers_bad += 1
+    return {
+        "lines": lines,
+        "transfers_ok": transfers_ok,
+        "transfers_bad": transfers_bad,
+        "unique_ids": len(ids_seen),
+        "redelivered_records": redelivered,
+        "dup_ids": dup_ids,
+    }
+
+
+def run_chaos(
+    n_sessions: int = 64,
+    conns: int = 4,
+    n_accounts: int = 128,
+    events_per_batch: int = 16,
+    batches_per_session: int = 6,
+    replica_count: int = 3,
+    backend: str = "native",
+    faults: tuple = ("kill_primary",),
+    restart_after_s: float = 2.0,
+    gray_s: float = 3.0,
+    disk_fault_on_restart: bool = True,
+    reply_slots: int = 64,
+    seed: int = 1,
+    jax_platform: str | None = "cpu",
+    deadline_s: float = 600.0,
+    settle_s: float = 1.0,
+    ingress: bool = False,
+    tmpdir: str | None = None,
+    log=None,
+) -> dict:
+    """The live chaos run. `faults` is an ordered tuple of CHAOS_ACTIONS
+    fired at evenly spaced acked-progress points of the workload:
+
+      kill_primary | kill_backup — SIGKILL (auto-restart after
+          `restart_after_s`; the FIRST restart flips WAL disk bytes when
+          disk_fault_on_restart);
+      gray_primary               — SIGSTOP for `gray_s`, then SIGCONT;
+      reset_conns                — RST every client connection.
+
+    Returns the verification report; raises on any lost/duplicated
+    transfer, CDC drift, or parity failure."""
+    import tempfile
+
+    log = log or (lambda *_: None)
+    rng = random.Random(seed)
+    own_tmp = tmpdir is None
+    if own_tmp:
+        tmp = tempfile.TemporaryDirectory(prefix="tb_chaos_")
+        tmpdir = tmp.name
+
+    ports = [free_port() for _ in range(replica_count)]
+    addresses = ",".join(f"127.0.0.1:{p}" for p in ports)
+    clients_max = n_sessions + 64
+    session_args = (
+        "--clients-max", str(clients_max),
+        "--client-reply-slots", str(reply_slots),
+    )
+    cluster_cfg = ConfigCluster(
+        replica_count=replica_count,
+        clients_max=clients_max,
+        client_reply_slots=reply_slots,
+    )
+    pp = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, PYTHONPATH=f"{REPO}:{pp}" if pp else REPO,
+               TB_PARENT_WATCHDOG="1")
+    if jax_platform:
+        env["TB_JAX_PLATFORM"] = jax_platform
+
+    # ledger slots sized to the workload (the server defaults allocate
+    # 2^24 transfer slots — three dual-backend replicas on one box would
+    # fight for memory before the first fault lands)
+    total_events = n_sessions * batches_per_session * events_per_batch
+    slots_log2 = 14
+    while total_events * 2 + 4096 > (1 << slots_log2) // 2:
+        slots_log2 += 1
+    acct_log2 = max(14, (n_accounts * 2 + 2).bit_length())
+    start_args = session_args + (
+        "--account-slots-log2", str(acct_log2),
+        "--transfer-slots-log2", str(slots_log2),
+    )
+
+    servers: list[ChaosServer] = []
+    paths: list[str] = []
+    for i in range(replica_count):
+        path = os.path.join(tmpdir, f"chaos_{i}.tigerbeetle")
+        paths.append(path)
+        fmt = subprocess.run(
+            [sys.executable, "-m", "tigerbeetle_tpu", "format",
+             "--cluster", "7", "--replica", str(i),
+             "--replica-count", str(replica_count),
+             *session_args, path],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert fmt.returncode == 0, fmt.stderr
+    cdc_path = os.path.join(tmpdir, "chaos_cdc.jsonl")
+    for i in range(replica_count):
+        extra: tuple = ("--ingress",) if ingress else ()
+        if i == 0:
+            # CDC rides replica 0 ACROSS its crashes: the durable cursor
+            # makes each incarnation resume (redeliveries dedup)
+            extra = extra + (
+                "--cdc-jsonl", cdc_path,
+                "--cdc-cursor", cdc_path + ".cursor",
+            )
+        servers.append(ChaosServer(
+            i, addresses, paths[i], env, backend, start_args, extra, log,
+        ))
+
+    metrics = Metrics()
+    fleet = None
+    report: dict = {
+        "sessions": n_sessions, "conns": conns, "backend": backend,
+        "replicas": replica_count, "faults": list(faults),
+        "kills": 0, "restarts": 0, "gray_stops": 0, "conn_resets": 0,
+        "disk_fault_slots": [],
+    }
+    try:
+        t0 = time.monotonic()
+        for s in servers:
+            s.spawn(wait=False)
+        for s in servers:
+            if not s.ready.wait(300.0):
+                raise TimeoutError(f"replica {s.index} never listened")
+        log(f"cluster up on {addresses} in {time.monotonic() - t0:.1f}s")
+
+        fleet = ChaosFleet(ports, n_sessions, conns, metrics)
+        reg_s = fleet.register_all()
+        log(f"{n_sessions} sessions registered in {reg_s:.1f}s")
+        report["register_s"] = round(reg_s, 2)
+
+        # accounts + one warm batch through session 0, off the clock
+        next_id = 1
+        while next_id <= n_accounts:
+            k = min(2048, n_accounts - next_id + 1)
+            body = fleet.execute(
+                fleet.sessions[0], Operation.create_accounts,
+                _accounts_body(next_id, k),
+            )
+            assert body == b"", "account create failed"
+            next_id += k
+        nrng = np.random.default_rng(seed)
+        warm = _transfers_body(nrng, 500_000, events_per_batch, n_accounts)
+        assert fleet.execute(
+            fleet.sessions[0], Operation.create_transfers, warm,
+            deadline_s=600.0,
+        ) == b""
+        warm_events = events_per_batch
+
+        # per-session workload queues, disjoint id namespaces (unique
+        # transfer ids cluster-wide: the CDC duplicate check bites)
+        stride = (batches_per_session + 2) * events_per_batch
+        for i, s in enumerate(fleet.sessions):
+            nid = 1_000_000 + i * stride
+            for _ in range(batches_per_session):
+                s.queue.append(
+                    _transfers_body(nrng, nid, events_per_batch, n_accounts)
+                )
+                nid += events_per_batch
+        fleet.total_events = (
+            n_sessions * batches_per_session * events_per_batch
+        )
+
+        plan = [
+            {"at": (k + 1) / (len(faults) + 1), "action": a, "done": False}
+            for k, a in enumerate(faults)
+        ]
+        pending_restarts: list[list] = []  # [when, server, flip_disk]
+        pending_cont: list[list] = []  # [when, server]
+        fault_marks: list[tuple[float, str]] = []
+
+        t_drive = time.monotonic()
+        log(f"driving {fleet.total_events} transfer events "
+            f"across {n_sessions} sessions")
+        while fleet.outstanding() > 0:
+            now = time.monotonic()
+            if now - t_drive > deadline_s:
+                raise TimeoutError(
+                    f"chaos drive stalled: {fleet.outstanding()} events "
+                    f"outstanding, errors={fleet.errors[:4]}"
+                )
+            if fleet.step(now) == 0:
+                time.sleep(0.0005)
+            if fleet.errors:
+                raise AssertionError(
+                    f"typed client errors during chaos: {fleet.errors[:4]}"
+                )
+            frac = fleet.acked_events / max(1, fleet.total_events)
+            for p in plan:
+                if p["done"] or frac < p["at"]:
+                    continue
+                p["done"] = True
+                action = p["action"]
+                if action in ("kill_primary", "kill_backup"):
+                    pi = fleet.view % replica_count
+                    idx = pi if action == "kill_primary" else (
+                        (pi + 1) % replica_count
+                    )
+                    victim = servers[idx]
+                    if not victim.alive:
+                        continue  # already down from an earlier fault
+                    victim.sigcont()
+                    victim.kill()
+                    report["kills"] += 1
+                    metrics.counter("chaos.kills").add()
+                    now = time.monotonic()
+                    fleet.mark_fault(now)
+                    fault_marks.append((now, action))
+                    log(f"chaos: SIGKILL replica {idx} ({action}) "
+                        f"at {frac:.0%} acked")
+                    pending_restarts.append([
+                        now + restart_after_s, victim,
+                        disk_fault_on_restart and report["restarts"] == 0,
+                    ])
+                elif action == "gray_primary":
+                    victim = servers[fleet.view % replica_count]
+                    if victim.alive and not victim.stopped:
+                        victim.sigstop()
+                        report["gray_stops"] += 1
+                        metrics.counter("chaos.gray_stops").add()
+                        now = time.monotonic()
+                        fleet.mark_fault(now)
+                        fault_marks.append((now, action))
+                        log(f"chaos: SIGSTOP replica {victim.index} "
+                            f"at {frac:.0%} acked")
+                        pending_cont.append([now + gray_s, victim])
+                elif action == "reset_conns":
+                    for b in fleet.buses:
+                        b.drop_connections()
+                    report["conn_resets"] += 1
+                    metrics.counter("chaos.conn_resets").add()
+                    now = time.monotonic()
+                    fleet.mark_fault(now)
+                    fault_marks.append((now, action))
+                    log(f"chaos: reset every client connection "
+                        f"at {frac:.0%} acked")
+                else:
+                    raise ValueError(f"unknown chaos action {action!r}")
+            for entry in list(pending_restarts):
+                when, srv, flip = entry
+                if now >= when and not srv.alive:
+                    pending_restarts.remove(entry)
+                    if flip:
+                        slots = inject_wal_fault(srv.path, cluster_cfg, rng)
+                        report["disk_fault_slots"] = slots
+                        log(f"chaos: disk-fault flip on replica "
+                            f"{srv.index}'s WAL (slots {slots})")
+                    srv.spawn(wait=False)  # boot happens off the loop
+                    report["restarts"] += 1
+                    metrics.counter("chaos.restarts").add()
+                    log(f"chaos: replica {srv.index} restarting")
+            for entry in list(pending_cont):
+                when, srv = entry
+                if now >= when:
+                    pending_cont.remove(entry)
+                    srv.sigcont()
+                    log(f"chaos: SIGCONT replica {srv.index}")
+        drive_wall = time.monotonic() - t_drive
+        for _w, srv, flip in pending_restarts:  # fault landed at the tail
+            # (the workload can drain before restart_after_s elapses —
+            # the tail respawn still owes the disk-fault flip)
+            if not srv.alive:
+                if flip:
+                    slots = inject_wal_fault(srv.path, cluster_cfg, rng)
+                    report["disk_fault_slots"] = slots
+                    log(f"chaos: disk-fault flip on replica "
+                        f"{srv.index}'s WAL (slots {slots})")
+                srv.spawn(wait=False)
+                report["restarts"] += 1
+                metrics.counter("chaos.restarts").add()
+        for _w, srv in pending_cont:
+            srv.sigcont()
+        for srv in servers:  # restarted replicas must finish booting
+            if srv.proc is not None and srv.alive:
+                srv.ready.wait(300.0)
+        log(f"workload drained: {fleet.acked_events} events acked in "
+            f"{drive_wall:.1f}s; recoveries_ms="
+            f"{[round(r) for r in fleet.recoveries_ms]}")
+
+        # settle, then verify conservation over the wire
+        time.sleep(settle_s)
+        total = fleet.acked_events + warm_events
+        from tigerbeetle_tpu.state_machine import decode_accounts, encode_ids
+
+        dpo = cpo = found = 0
+        for i in range(0, n_accounts, 8000):
+            ids = list(range(1 + i, 1 + min(i + 8000, n_accounts)))
+            body = fleet.execute(
+                fleet.sessions[0], Operation.lookup_accounts,
+                encode_ids(ids),
+            )
+            arr = decode_accounts(body)
+            found += len(arr)
+            dpo += int(arr["debits_posted_lo"].sum())
+            cpo += int(arr["credits_posted_lo"].sum())
+        assert found == n_accounts, (found, n_accounts)
+        assert dpo == cpo == total, (
+            f"conservation violated: debits={dpo} credits={cpo} "
+            f"acked={total} — lost or duplicated transfers"
+        )
+        log(f"wire conservation verified: {total} transfers")
+
+        # Catch-up barrier: the CDC stream can only carry what replica 0
+        # COMMITTED, and a twice-crashed streamer may still be repairing
+        # its log from peers — wait for every replica to reach the
+        # cluster head (the highest op a client reply named) before the
+        # shutdown drain reads the stream's tail.
+        from tigerbeetle_tpu.inspect import inspect_live
+
+        target = fleet.max_op
+        t_w = time.monotonic()
+        for s in servers:
+            while True:
+                if time.monotonic() - t_w > 300.0:
+                    raise TimeoutError(
+                        f"replica {s.index} never caught up to op {target}"
+                    )
+                try:
+                    live = inspect_live(
+                        "127.0.0.1", ports[s.index], timeout=2.0
+                    )
+                    if live["commit_min"] >= target:
+                        break
+                except (OSError, RuntimeError, ValueError):
+                    pass  # booting / mid-recovery: poll again
+                time.sleep(0.25)
+        log(f"all replicas caught up to op {target} "
+            f"in {time.monotonic() - t_w:.1f}s")
+
+        # graceful shutdown: parity + the CDC final drain live in SIGTERM
+        parity = {}
+        for s in servers:
+            stats = s.terminate()
+            shadow = stats.get("device_shadow") or {}
+            parity[f"r{s.index}"] = {
+                "verified": shadow.get("verified"),
+                "hash_log_ok": (shadow.get("hash_log") or {}).get("ok"),
+            }
+
+        cdc = _parse_cdc_stream(cdc_path)
+        assert cdc["dup_ids"] == 0, f"duplicated transfers in CDC: {cdc}"
+        assert cdc["transfers_bad"] == 0, (
+            f"non-ok transfer results in CDC (double execution?): {cdc}"
+        )
+        assert cdc["unique_ids"] == total, (
+            f"cdc stream drift: {cdc['unique_ids']} unique transfers "
+            f"vs {total} acked"
+        )
+        log(f"cdc stream verified: {cdc['unique_ids']} transfers "
+            f"({cdc['redelivered_records']} redelivered records deduped)")
+
+        if backend in ("dual", "native+device"):
+            bad = {
+                k: v for k, v in parity.items()
+                if not v["verified"] or v["hash_log_ok"] is False
+            }
+            assert not bad, f"device parity failed after chaos: {bad}"
+
+        # Post-failover throughput ratio from the acked timeline:
+        # SYMMETRIC fixed-width windows — the W seconds ending at the
+        # first fault vs the W seconds starting at its recovery. (Whole-
+        # span averages lie twice: the pre-span starts with the issue
+        # burst and the post-span ends with the sparse drain tail.)
+        tps_pre = tps_post = None
+        if fault_marks and fleet.recoveries_ms and fleet.acked_timeline:
+            t_fault = fault_marks[0][0]
+            t_rec = t_fault + fleet.recoveries_ms[0] / 1e3
+            t_end = fleet.acked_timeline[-1][0]
+            w = min(2.0, t_fault - t_drive, max(0.0, t_end - t_rec))
+            if w > 0.05:
+                tps_pre = sum(
+                    n for t, n in fleet.acked_timeline
+                    if t_fault - w <= t < t_fault
+                ) / w
+                tps_post = sum(
+                    n for t, n in fleet.acked_timeline
+                    if t_rec <= t < t_rec + w
+                ) / w
+
+        snap = metrics.snapshot()["counters"]
+        report.update({
+            "acked_events": fleet.acked_events,
+            "lost_events": fleet.outstanding(),
+            "wall_s": round(drive_wall, 2),
+            "tps": round(fleet.acked_events / drive_wall, 1),
+            "failover_recovery_ms": (
+                round(fleet.recoveries_ms[0], 1)
+                if fleet.recoveries_ms else None
+            ),
+            "recoveries_ms": [round(r, 1) for r in fleet.recoveries_ms],
+            "tps_pre_fault": round(tps_pre, 1) if tps_pre else None,
+            "tps_post_recovery": round(tps_post, 1) if tps_post else None,
+            "post_failover_tps_ratio": (
+                round(tps_post / tps_pre, 3) if tps_pre and tps_post
+                else None
+            ),
+            "conservation_ok": True,
+            "cdc": cdc,
+            "parity": parity,
+            "client": {
+                k.split(".", 1)[1]: v for k, v in snap.items()
+                if k.startswith("client.")
+            },
+            "bus_reconnects": snap.get("bus.reconnects", 0),
+            "bus_dial_failures": snap.get("bus.dial_failures", 0),
+        })
+        return report
+    finally:
+        if fleet is not None:
+            fleet.close()
+        for s in servers:
+            s.sigcont()
+            if s.proc is not None:
+                kill_process_group(s.proc)
+        if own_tmp:
+            tmp.cleanup()
+
+
+def run_failover(
+    n_sessions: int = 64,
+    conns: int = 4,
+    events_per_batch: int = 64,
+    batches_per_session: int = 10,
+    backend: str = "native",
+    **kw,
+) -> dict:
+    """The bench `failover` segment: one SIGKILL of the primary mid-run;
+    reports failover_recovery_ms and post_failover_tps_ratio (acked-event
+    rate after recovery vs before the kill)."""
+    return run_chaos(
+        n_sessions=n_sessions, conns=conns,
+        events_per_batch=events_per_batch,
+        batches_per_session=batches_per_session,
+        backend=backend, faults=("kill_primary",),
+        disk_fault_on_restart=False, **kw,
+    )

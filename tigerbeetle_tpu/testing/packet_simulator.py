@@ -36,6 +36,8 @@ class PacketSimulatorOptions:
         unpartition_probability: float = 0.2,  # per tick: heal it
         partition_modes: tuple = PARTITION_MODES,
         partition_symmetry_probability: float = 0.7,  # else one-way cut
+        client_loss_probability: float = 0.0,
+        client_replay_probability: float = 0.0,
     ):
         self.one_way_delay_min = one_way_delay_min
         self.one_way_delay_max = one_way_delay_max
@@ -45,6 +47,15 @@ class PacketSimulatorOptions:
         self.unpartition_probability = unpartition_probability
         self.partition_modes = partition_modes
         self.partition_symmetry_probability = partition_symmetry_probability
+        # Client-link fault dial (ADDITIVE to the general loss/replay):
+        # frames with a client endpoint — requests, replies, busy sheds,
+        # evictions, pings — drop or duplicate at their own rate, so the
+        # client runtime's timeout/retarget/dedup transitions get
+        # exercised without destabilizing the consensus links. Zero (the
+        # default) draws nothing from the rng: pre-existing seeds replay
+        # byte-identically.
+        self.client_loss_probability = client_loss_probability
+        self.client_replay_probability = client_replay_probability
 
 
 class PacketSimulator(Network):
@@ -155,10 +166,28 @@ class PacketSimulator(Network):
         if o.packet_loss_probability and self.rng.random() < o.packet_loss_probability:
             self.stats["lost"] += 1
             return
+        client_link = not (self._is_replica(src) and self._is_replica(dst))
+        if (
+            client_link
+            and o.client_loss_probability
+            and self.rng.random() < o.client_loss_probability
+        ):
+            self.stats["client_lost"] = self.stats.get("client_lost", 0) + 1
+            return
         copies = 1
         if o.packet_replay_probability and self.rng.random() < o.packet_replay_probability:
             copies = 2
             self.stats["replayed"] += 1
+        if (
+            client_link
+            and copies == 1
+            and o.client_replay_probability
+            and self.rng.random() < o.client_replay_probability
+        ):
+            copies = 2
+            self.stats["client_replayed"] = (
+                self.stats.get("client_replayed", 0) + 1
+            )
         clogged = (
             self._is_replica(src) and self._is_replica(dst)
             and (src, dst) in self.clogged_links

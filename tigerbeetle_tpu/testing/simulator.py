@@ -37,7 +37,7 @@ from tigerbeetle_tpu.testing.packet_simulator import (
 )
 from tigerbeetle_tpu.testing.workload import WorkloadGenerator
 from tigerbeetle_tpu.types import Operation
-from tigerbeetle_tpu.vsr.client import Client
+from tigerbeetle_tpu.vsr.client import Client, RequestTimeout, SessionEvicted
 from tigerbeetle_tpu.vsr.durable import format_data_file
 from tigerbeetle_tpu.vsr.header import Header
 from tigerbeetle_tpu.vsr.replica import Replica
@@ -314,36 +314,56 @@ class SimCdcFanout:
 
 
 class SimClient:
-    """Workload-driving client with tick-based retries."""
+    """Workload driver riding the client RUNTIME: retries, exponential
+    backoff, round-robin re-targeting, busy backoff and (opt-in)
+    re-registration all happen inside Client.tick() — the driver only
+    issues work and takes replies, the same contract the live chaos
+    fleet runs under. Typed errors surface through poll(): an eviction
+    is fatal unless the client auto-re-registers (then it is counted and
+    the session resumes), a deadline expiry counts and the slot retries
+    with fresh work."""
 
     def __init__(self, client: Client, seed: int, batch_size: int = 8,
-                 workload_knobs: dict | None = None):
+                 workload_knobs: dict | None = None,
+                 tick_stride: int = 1, tick_burst: int = 1):
         self.client = client
         self.gen = WorkloadGenerator(seed, **(workload_knobs or {}))
         self.batch = batch_size
         self.rng = random.Random(seed * 13 + 7)
-        self.sent_tick = 0
         self.replies = 0
         self.batch_index = 0
+        self.evictions = 0
+        self.deadline_timeouts = 0
+        # Clock-skew dial: this client's runtime clock ticks at a skewed
+        # rate against sim time (stride > 1 = slow clock, burst > 1 =
+        # fast clock), so timeout/backoff firing interleaves differently
+        # per client — the "clock-skewed timeout firing" fault axis.
+        self.tick_stride = tick_stride
+        self.tick_burst = tick_burst
 
     drain_mode = False  # heal phase: finish in-flight work, issue nothing new
 
     def tick(self, now: int) -> None:
         c = self.client
-        if c.evicted:
-            raise AssertionError("client evicted during simulation")
+        if now % self.tick_stride == 0:
+            for _ in range(self.tick_burst):
+                c.tick()
+        try:
+            c.poll()
+        except SessionEvicted:
+            self.evictions += 1
+            if not c.auto_reregister:
+                raise AssertionError("client evicted during simulation")
+        except RequestTimeout:
+            self.deadline_timeouts += 1
         if c.reply is not None:
             c.take_reply()
             self.replies += 1
         if self.drain_mode and c.in_flight is None:
             return
         if c.session == 0:
-            if c.in_flight is None:
+            if c.in_flight is None and not c._want_reregister:
                 c.register()
-                self.sent_tick = now
-            elif now - self.sent_tick > CLIENT_RETRY_TICKS:
-                c.resend()
-                self.sent_tick = now
             return
         if c.in_flight is None:
             if self.rng.random() < 0.5:
@@ -356,10 +376,6 @@ class SimClient:
                 op, events = self.gen.gen_transfers_batch(self.batch)
                 body = types.transfers_to_np(events).tobytes()
             c.request(op, body)
-            self.sent_tick = now
-        elif now - self.sent_tick > CLIENT_RETRY_TICKS:
-            c.resend()
-            self.sent_tick = now
 
 
 class Simulator:
@@ -394,6 +410,10 @@ class Simulator:
         ingress_gateway: bool = False,
         storm_clients: int = 0,
         hash_log: tuple[str, str] | None = None,
+        client_auto_reregister: bool = False,
+        client_deadline_ticks: int = 0,
+        client_tick_skew: bool = False,
+        primary_crash_probability: float = 0.0,
     ):
         from tigerbeetle_tpu.constants import TEST_PROCESS
 
@@ -524,13 +544,19 @@ class Simulator:
         self.superblock_faults = 0
         self.grid_faults = 0
 
+        # Client-runtime fault axes (all seed-deterministic): opt-in
+        # automatic re-registration after eviction, per-request deadlines
+        # (RequestTimeout), skewed client clocks, and targeted crashes of
+        # the PRIMARY while client requests are in flight.
+        self.client_auto_reregister = client_auto_reregister
+        self.client_deadline_ticks = client_deadline_ticks
+        self.client_tick_skew = client_tick_skew
+        self.primary_crash_probability = primary_crash_probability
+        self.primary_crashes = 0
+        self._client_batch = client_batch
+        self._workload_knobs = workload_knobs
         self.clients = [
-            SimClient(
-                Client(CLIENT_ID_BASE + i, self.net, replica_count),
-                seed * 7 + i, batch_size=client_batch,
-                workload_knobs=workload_knobs,
-            )
-            for i in range(n_clients)
+            self._new_sim_client(i) for i in range(n_clients)
         ]
 
         # Deterministic CDC consumer (tigerbeetle_tpu/cdc): tails replica
@@ -567,8 +593,34 @@ class Simulator:
         )
         self._storm_seed = seed
         self._n_clients = n_clients
-        self._client_batch = client_batch
-        self._workload_knobs = workload_knobs
+        # (_client_batch/_workload_knobs were stored above, before the
+        # client list — _new_sim_client reads them)
+
+    def _new_sim_client(self, i: int) -> SimClient:
+        """One seeded workload client on the tick-driven runtime. The
+        skew draws come from the client's OWN derived rng (not self.rng),
+        so enabling skew never shifts the crash/fault schedule of a
+        seed's other draws."""
+        stride = burst = 1
+        if self.client_tick_skew:
+            skew_rng = random.Random(self.seed * 41 + i * 3 + 2)
+            stride = skew_rng.choice((1, 1, 2, 3))
+            burst = skew_rng.choice((1, 1, 2)) if stride == 1 else 1
+        return SimClient(
+            Client(
+                CLIENT_ID_BASE + i, self.net, self.replica_count,
+                request_timeout_ticks=CLIENT_RETRY_TICKS,
+                # short runs need a snappy ladder: cap at 4x base (the
+                # live default caps at 16x — seconds-scale wall time)
+                max_backoff_exponent=2,
+                ping_ticks=40,
+                deadline_ticks=self.client_deadline_ticks,
+                auto_reregister=self.client_auto_reregister,
+            ),
+            self.seed * 7 + i, batch_size=self._client_batch,
+            workload_knobs=self._workload_knobs,
+            tick_stride=stride, tick_burst=burst,
+        )
 
     def _make_replica(self, i: int) -> Replica:
         r = Replica(
@@ -616,25 +668,49 @@ class Simulator:
 
     # -- fault scheduling --
 
+    def _crash(self, victim: int, now: int) -> None:
+        self.crashes += 1
+        if self.rng.random() < self.torn_write_probability:
+            self._inject_torn_head(victim)
+        self.net.crashed.add(victim)
+        self.down[victim] = now + self.rng.randint(
+            10, self.restart_ticks_max
+        )
+
     def _maybe_crash(self, now: int) -> None:
         alive = [i for i in range(self.total_replicas) if i not in self.down]
         # quorum safety counts ACTIVE replicas only; standbys (index >=
         # replica_count) may crash freely — they hold no votes
         active_down = sum(1 for i in self.down if i < self.replica_count)
         max_down = (self.replica_count - 1) // 2
+        # Targeted fault: SIGKILL-the-primary with client requests IN
+        # FLIGHT — the failover transition the client runtime's
+        # timeout -> re-target -> duplicate-reply-dedup ladder exists
+        # for. Probability 0 (the default) draws nothing.
+        if (
+            self.primary_crash_probability
+            and self.rng.random() < self.primary_crash_probability
+            and active_down < max_down
+            and any(c.client.in_flight is not None for c in self.clients)
+        ):
+            views = [
+                self.replicas[i].view
+                for i in range(self.replica_count)
+                if i not in self.down and self.replicas[i].status == "normal"
+            ]
+            if views:
+                primary = max(views) % self.replica_count
+                if primary not in self.down:
+                    self.primary_crashes += 1
+                    self._crash(primary, now)
+                    return
         if self.rng.random() < self.crash_probability:
             if active_down >= max_down:
                 alive = [i for i in alive if i >= self.replica_count]
                 if not alive:
                     return
             victim = self.rng.choice(alive)
-            self.crashes += 1
-            if self.rng.random() < self.torn_write_probability:
-                self._inject_torn_head(victim)
-            self.net.crashed.add(victim)
-            self.down[victim] = now + self.rng.randint(
-                10, self.restart_ticks_max
-            )
+            self._crash(victim, now)
 
     def _inject_torn_head(self, i: int) -> None:
         """Crash-point torn write: the victim's most recent journal write
@@ -830,15 +906,7 @@ class Simulator:
                 self.storm_tick = None
                 base = len(self.clients)
                 for i in range(self.storm_clients):
-                    self.clients.append(SimClient(
-                        Client(
-                            CLIENT_ID_BASE + base + i, self.net,
-                            self.replica_count,
-                        ),
-                        self._storm_seed * 7 + base + i,
-                        batch_size=self._client_batch,
-                        workload_knobs=self._workload_knobs,
-                    ))
+                    self.clients.append(self._new_sim_client(base + i))
             for c in self.clients:
                 c.tick(now)
             if self.cdc is not None:
@@ -896,6 +964,17 @@ class Simulator:
             ].refusals
         if self.storm_clients:
             out_cdc["storm_clients"] = self.storm_clients
+        if self.primary_crash_probability:
+            out_cdc["primary_crashes"] = self.primary_crashes
+        if self.client_auto_reregister:
+            # every surfaced eviction pairs with one automatic re-register
+            out_cdc["client_evictions"] = sum(
+                c.evictions for c in self.clients
+            )
+        if self.client_deadline_ticks:
+            out_cdc["client_deadline_timeouts"] = sum(
+                c.deadline_timeouts for c in self.clients
+            )
         if self.hash_log is not None:
             out_cdc["hash_log_mode"] = self.hash_log.mode
             # ops THIS RUN streamed/verified — in check mode len(entries)
@@ -930,7 +1009,12 @@ class Simulator:
             del self.down[i]
             self.net.crashed.discard(i)
             self.replicas[i] = self._make_replica(i)
-        budget = 600
+        # The budget must cover a full capped-backoff retry CYCLE of the
+        # client runtime (a request that spent the fault phase retrying
+        # sits at the top of its ladder — base 30 * 2^4 plus jitter —
+        # and may need several re-targeted fires to find the primary);
+        # the loop exits at quiescence, so healthy seeds don't pay this.
+        budget = 2400
         for _ in range(budget):
             for i, r in enumerate(self.replicas):
                 self.times[i].tick()

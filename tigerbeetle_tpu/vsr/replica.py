@@ -696,6 +696,17 @@ class Replica:
                 self.time.monotonic(),
             )
             return
+        if cmd == Command.ping_client:
+            # Client view discovery (reference: src/vsr/replica.zig
+            # on_ping_client): answer only in normal status — the pong's
+            # view (stamped by _send) tells an idle client where the
+            # primary is, so its next request targets the current view.
+            if self.status == "normal" and header.client:
+                pong = Header(
+                    command=int(Command.pong_client), client=header.client
+                )
+                self._send(header.client, pong)
+            return
         if cmd == Command.request_stats:
             self._on_request_stats(header)
             return
