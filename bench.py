@@ -165,15 +165,37 @@ def bench_tracked_configs(stage) -> dict:
 
     out = {}
     n_runs = int(os.environ.get("BENCH_CFG_RUNS", 3))
+    # Events per tracked-config batch. Default = the protocol BATCH (the
+    # rig artifact); smaller values exist for CPU-sandbox artifacts — the
+    # serial tier is a lax.scan of one step per EVENT, so a full 8190-
+    # event chains/balancing config costs hours on one CPU core. The
+    # chosen value rides out in the artifact (`cfg_batch` field below)
+    # and all config-vs-config ratios stay batch-size-consistent.
+    cbatch = int(os.environ.get("BENCH_CFG_BATCH", BATCH))
+    cpad = BATCH_PAD if cbatch >= BATCH else max(
+        8, 1 << (cbatch - 1).bit_length()
+    )
+    # Transfer-table size scales with cbatch at the protocol's load factor
+    # (2^22 slots for 5 full batches): the serial tier's lax.scan carries
+    # the whole table as loop state, and XLA-CPU materializes it per step
+    # — table SIZE, not event count, drives serial cost off the rig
+    # (measured: 256-event chains batch, 2^22 table 50 s vs 2^18 1.8 s;
+    # on the rig donation aliases the update in place and this is free).
+    xfer_log2 = 22
+    while xfer_log2 > 16 and (1 << (xfer_log2 - 1)) * BATCH >= (1 << 22) * cbatch:
+        xfer_log2 -= 1
+    out["cfg_batch"] = cbatch
 
     def fresh(n_accounts=N_ACCOUNTS):
-        process = ConfigProcess(account_slots_log2=16, transfer_slots_log2=22)
+        process = ConfigProcess(
+            account_slots_log2=16, transfer_slots_log2=xfer_log2
+        )
         ledger = DeviceLedger(process=process, mode="auto")
-        ledger.pad_to = BATCH_PAD
+        ledger.pad_to = cpad
         ts = 1 << 40
         next_id = 1
         while next_id <= n_accounts:
-            k = min(BATCH, n_accounts - next_id + 1)
+            k = min(cbatch, n_accounts - next_id + 1)
             ts += k
             ledger.execute_async(
                 Operation.create_accounts, ts, build_accounts(next_id, k)
@@ -181,11 +203,13 @@ def bench_tracked_configs(stage) -> dict:
             next_id += k
         return ledger, ts
 
-    def run_batches(ledger, ts, batches, events_per_batch=BATCH,
+    def run_batches(ledger, ts, batches, events_per_batch=None,
                     warmup=1) -> float:
         """`warmup` batches absorb jit compiles and must exercise every tier
         the timed batches hit (two-phase passes 2: pending=fast,
         post=fast_pv). Returns the timed TPS."""
+        if events_per_batch is None:
+            events_per_batch = cbatch
         pends = []
         for b in batches[:warmup]:
             ts += events_per_batch
@@ -205,6 +229,7 @@ def bench_tracked_configs(stage) -> dict:
         are process-cached, so only run 1 pays compiles — its warmup
         batches absorb them) and reports median + per-run values + spread
         (round-4 verdict: single samples swung 2x between bench runs)."""
+        t0 = time.perf_counter()
         vals = [one_run(np.random.default_rng(77 + 13 * i))
                 for i in range(n_runs)]
         med = float(np.median(vals))
@@ -213,13 +238,20 @@ def bench_tracked_configs(stage) -> dict:
         out[name + "_spread"] = (
             round((max(vals) - min(vals)) / med, 4) if med else None
         )
+        # progress attribution: the configs are the bench's longest silent
+        # stretch — without this line a stall cannot be pinned to a config
+        print(
+            f"[cfg] {name}: {out[name]:.1f} spread="
+            f"{out[name + '_spread']} ({time.perf_counter() - t0:.1f}s)",
+            file=sys.stderr,
+        )
 
     # 1. read path: lookup_accounts over full id batches
     def cfg_lookup(rng):
         ledger, ts = fresh()
         ids = ids_to_batch(
-            [int(x) for x in rng.integers(1, N_ACCOUNTS + 1, size=BATCH)],
-            BATCH_PAD,
+            [int(x) for x in rng.integers(1, N_ACCOUNTS + 1, size=cbatch)],
+            cpad,
         )
         k = ledger.kernels.lookup_accounts
         jax.block_until_ready(k(ledger.state, ids)[0])  # compile
@@ -227,7 +259,7 @@ def bench_tracked_configs(stage) -> dict:
         for _ in range(20):
             found, rows, res = k(ledger.state, ids)
         jax.block_until_ready(found)
-        return 20 * BATCH / (time.perf_counter() - t0)
+        return 20 * cbatch / (time.perf_counter() - t0)
 
     with stage("cfg_lookup"):
         median_config("lookup_accounts_per_s", cfg_lookup)
@@ -238,11 +270,11 @@ def bench_tracked_configs(stage) -> dict:
         ledger, ts = fresh()
         batches = []
         for g in range(4):
-            base = 1 + g * 2 * BATCH
-            pend = build_transfers(rng, base, BATCH)
+            base = 1 + g * 2 * cbatch
+            pend = build_transfers(rng, base, cbatch)
             pend["flags"] = 2  # pending
-            post = np.zeros(BATCH, dtype=TRANSFER_DTYPE)
-            post["id_lo"] = np.arange(base + BATCH, base + 2 * BATCH, dtype=np.uint64)
+            post = np.zeros(cbatch, dtype=TRANSFER_DTYPE)
+            post["id_lo"] = np.arange(base + cbatch, base + 2 * cbatch, dtype=np.uint64)
             post["pending_id_lo"] = pend["id_lo"]
             post["flags"] = 4  # post_pending_transfer
             batches += [pend, post]
@@ -256,7 +288,7 @@ def bench_tracked_configs(stage) -> dict:
         ledger, ts = fresh()
         batches = []
         for g in range(3):
-            b = build_transfers(rng, 1 + g * BATCH, BATCH)
+            b = build_transfers(rng, 1 + g * cbatch, cbatch)
             b["flags"] = 1  # linked
             b["flags"][3::4] = 0  # chain terminators every 4th event
             b["flags"][-1] = 0
@@ -269,12 +301,12 @@ def bench_tracked_configs(stage) -> dict:
     # 4. balancing: balancing_debit over funded accounts (exact serial tier)
     def cfg_balancing(rng):
         ledger, ts = fresh()
-        seed_batch = build_transfers(rng, 1, BATCH)  # fund accounts first
-        ts += BATCH
+        seed_batch = build_transfers(rng, 1, cbatch)  # fund accounts first
+        ts += cbatch
         ledger.execute_async(Operation.create_transfers, ts, seed_batch)
         batches = []
         for g in range(3):
-            b = build_transfers(rng, 1 + (g + 1) * BATCH, BATCH)
+            b = build_transfers(rng, 1 + (g + 1) * cbatch, cbatch)
             b["flags"] = 16  # balancing_debit
             batches.append(b)
         return run_batches(ledger, ts, batches)
@@ -283,27 +315,28 @@ def bench_tracked_configs(stage) -> dict:
         median_config("balancing_tps", cfg_balancing)
 
     # 5. mixed: ~88% simple transfers + ~6% posts (fast_pv lanes) + ~6%
-    # linked-chain pairs on their own accounts -> the conflict-partitioned
-    # SPLIT executor (fast_pv majority + compacted serial residue)
+    # linked-chain pairs on their own accounts -> the conflict-WAVE
+    # scheduler with a serial residue (the chains; everything else rides
+    # one fast_pv wave)
     def cfg_mixed(rng):
         ledger, ts = fresh()
-        pend0 = build_transfers(rng, 1, BATCH)
+        pend0 = build_transfers(rng, 1, cbatch)
         pend0["flags"] = 2
         # keep pending accounts in a reserved low range, disjoint from the
         # fast majority below
         # pending accounts 1..599: disjoint from the chain range (600..900)
         # AND the fast majority (>1000), so the fixpoint cannot cascade
-        pend0["debit_account_id_lo"] = 1 + (np.arange(BATCH) % 300)
-        pend0["credit_account_id_lo"] = 301 + (np.arange(BATCH) % 299)
-        ts += BATCH
+        pend0["debit_account_id_lo"] = 1 + (np.arange(cbatch) % 300)
+        pend0["credit_account_id_lo"] = 301 + (np.arange(cbatch) % 299)
+        ts += cbatch
         ledger.execute_async(Operation.create_transfers, ts, pend0)
         batches = []
-        n_res = BATCH // 16  # ~512 residue events
+        n_res = cbatch // 16  # residue events (~512 at the protocol BATCH)
         for g in range(4):
-            b = build_transfers(rng, 1 + (g + 1) * BATCH, BATCH)
+            b = build_transfers(rng, 1 + (g + 1) * cbatch, cbatch)
             # fast majority over accounts > 1000
-            dr = rng.integers(1001, N_ACCOUNTS + 1, size=BATCH, dtype=np.uint64)
-            off = rng.integers(1, N_ACCOUNTS - 1001, size=BATCH, dtype=np.uint64)
+            dr = rng.integers(1001, N_ACCOUNTS + 1, size=cbatch, dtype=np.uint64)
+            off = rng.integers(1, N_ACCOUNTS - 1001, size=cbatch, dtype=np.uint64)
             b["debit_account_id_lo"] = dr
             b["credit_account_id_lo"] = (dr - 1001 + off) % (N_ACCOUNTS - 1000) + 1001
             # residue: posts of the pending batch, scattered through the lanes
@@ -319,7 +352,7 @@ def bench_tracked_configs(stage) -> dict:
             b["credit_account_id_lo"][pair] = 751 + (pair % 150)
             # posts of prior-batch pendings (fast_pv lanes) in the remainder
             post_lanes = rng.choice(
-                np.arange(2 * k, BATCH), size=n_res, replace=False
+                np.arange(2 * k, cbatch), size=n_res, replace=False
             )
             b["pending_id_lo"][post_lanes] = pend0["id_lo"][g * n_res:(g + 1) * n_res]
             b["debit_account_id_lo"][post_lanes] = 0
@@ -328,14 +361,77 @@ def bench_tracked_configs(stage) -> dict:
             b["flags"][post_lanes] = 4
             batches.append(b)
         tps = run_batches(ledger, ts, batches)
+        # plan_stats carries the wave-planner keys AND the deprecated
+        # split/split_pv compat keys (same dict) — dashboards reading
+        # split_stats keep working, new readers take the wave keys
+        ps = ledger.hazards.plan_stats
         out["split_stats"] = dict(ledger.hazards.split_stats)
-        assert ledger.hazards.split_stats.get("split_pv", 0) >= 3, (
-            "mixed config must exercise the split executor"
+        out["wave_plan_stats"] = dict(ps)
+        assert ps.get("waves", 0) >= 3, (
+            "mixed config must exercise the conflict-wave scheduler"
+        )
+        assert ps.get("residue_events", 0) > 0, (
+            "mixed config's linked chains must fall to the serial residue"
         )
         return tps
 
     with stage("cfg_mixed"):
         median_config("mixed_split_tps", cfg_mixed)
+
+    # 5b. hot-account waves (ROADMAP item 2's workload): a few viral hot
+    # accounts absorb most traffic AND every batch carries same-batch
+    # pend->post dependency pairs. The retired all-or-nothing analysis
+    # serialized such batches whole; the wave planner runs them as ~2
+    # dependency-ordered waves (each post one wave after its creator),
+    # with NO serial residue.
+    def cfg_mixed_hot(rng):
+        ledger, ts = fresh()
+        batches = []
+        n_dep = cbatch // 8  # same-batch pend->post pairs per batch
+        for g in range(4):
+            b = build_transfers(rng, 1 + g * cbatch, cbatch)
+            # zipf-flavored mix: ~25% of debits hit ONE hot account, the
+            # rest spread power-law across the id space
+            u = rng.random(cbatch)
+            dr = (1 + (N_ACCOUNTS - 1) * u**3).astype(np.uint64)
+            dr[rng.random(cbatch) < 0.25] = 1
+            off = rng.integers(1, N_ACCOUNTS, size=cbatch, dtype=np.uint64)
+            b["debit_account_id_lo"] = dr
+            b["credit_account_id_lo"] = (dr - 1 + off) % N_ACCOUNTS + 1
+            b["flags"][:n_dep] = 2  # pendings...
+            post_lanes = rng.choice(  # ...posted later IN THE SAME BATCH
+                np.arange(n_dep, cbatch), size=n_dep, replace=False
+            )
+            b["pending_id_lo"][post_lanes] = b["id_lo"][:n_dep]
+            b["debit_account_id_lo"][post_lanes] = 0
+            b["credit_account_id_lo"][post_lanes] = 0
+            b["amount_lo"][post_lanes] = 0
+            b["flags"][post_lanes] = 4
+            batches.append(b)
+        tps = run_batches(ledger, ts, batches)
+        ps = ledger.hazards.plan_stats
+        out["mixed_hot_plan_stats"] = dict(ps)
+        assert ps.get("waves", 0) >= 3, (
+            "hot config must run the conflict-wave scheduler"
+        )
+        assert ps.get("residue_events", 0) == 0, (
+            "hot config has no chains/balancing: nothing may fall serial"
+        )
+        return tps
+
+    with stage("cfg_mixed_hot"):
+        median_config("mixed_hot_tps", cfg_mixed_hot)
+
+    # dependent-transfer segments vs the fast path, measured under the
+    # SAME synced per-batch protocol (two_phase_tps is the pure
+    # fast/fast_pv configuration) — ROADMAP item 2 targets >= 0.5x
+    if out.get("two_phase_tps"):
+        out["mixed_vs_fast_ratio"] = round(
+            out["mixed_split_tps"] / out["two_phase_tps"], 4
+        )
+        out["mixed_hot_vs_fast_ratio"] = round(
+            out["mixed_hot_tps"] / out["two_phase_tps"], 4
+        )
 
     # 6. spill-active steady state: the transfer table's HBM budget is a
     # fraction of the workload, so the cold tail spills to the LSM forest
@@ -1212,6 +1308,21 @@ def main() -> None:
                 "fuse_window_us": e2e.get("fuse_window_us"),
                 "shadow_upload_overlap": e2e.get("shadow_upload_overlap"),
                 "loop_us_per_batch": e2e.get("loop_us_per_batch"),
+                # conflict-wave scheduler segments (dependent transfers):
+                # mixed = chains+posts+fast majority (wave + serial
+                # residue), hot = zipfian hot accounts + same-batch
+                # pend->post pairs (pure waves); ratios are vs
+                # two_phase_tps, the fast-path segment under the same
+                # synced per-batch protocol (ROADMAP item 2: >= 0.5x)
+                "mixed_split_tps": configs.get("mixed_split_tps", 0.0),
+                "mixed_split_spread": configs.get("mixed_split_tps_spread"),
+                "mixed_hot_tps": configs.get("mixed_hot_tps", 0.0),
+                "mixed_hot_spread": configs.get("mixed_hot_tps_spread"),
+                "mixed_vs_fast_ratio": configs.get("mixed_vs_fast_ratio"),
+                "mixed_hot_vs_fast_ratio": configs.get(
+                    "mixed_hot_vs_fast_ratio"
+                ),
+                "two_phase_tps": configs.get("two_phase_tps", 0.0),
                 "spill_active_tps": configs.get("spill_active_tps", 0.0),
                 # overlap accounting: reload gather time hidden behind
                 # commits (1.0 = admit never waited on the IO worker) and

@@ -1,0 +1,64 @@
+"""Assemble a BENCH_r0N.json driver-shaped artifact from a bench.py run.
+
+The driver's artifacts (`BENCH_r0*.json`) wrap one repeated `python
+bench.py` invocation as {n, cmd, rc, tail, parsed}. When a round's
+artifact is produced in-session instead (the driver hasn't run since
+r05), this script builds the same shape from a captured run and adds the
+provenance fields an honest off-rig artifact needs: the platform, the
+size-reduction env knobs, and any segment failures — so no number can be
+mistaken for a rig number.
+
+Usage:
+  python scripts/make_bench_artifact.py OUT.json STDOUT STDERR RC 'ENV...'
+"""
+
+import json
+import platform
+import sys
+
+
+def main() -> int:
+    out_path, stdout_path, stderr_path, rc, env = sys.argv[1:6]
+    parsed = None
+    with open(stdout_path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    for ln in reversed(lines):  # bench prints the summary JSON last
+        try:
+            parsed = json.loads(ln)
+            break
+        except json.JSONDecodeError:
+            continue
+    if parsed is None:
+        print("no JSON summary in stdout — bench did not finish", file=sys.stderr)
+        return 1
+    with open(stderr_path) as f:
+        tail = f.read()[-8000:]
+    artifact = {
+        "n": 1,
+        "cmd": f"env {env} python bench.py",
+        "rc": int(rc),
+        # Off-rig provenance: r01-r05 ran on the TPU v5e rig via the
+        # driver; this round ran in-session on the CPU sandbox (1 core,
+        # JAX_PLATFORMS=cpu) with the size knobs recorded in `cmd`/`env`.
+        # Absolute tps is NOT comparable to r05; same-run ratios
+        # (`*_vs_fast_ratio`, spreads, parity booleans) are the quotable
+        # signals. See README "Conflict-wave scheduling".
+        "platform": {
+            "backend": "cpu",
+            "machine": platform.machine(),
+            "python": platform.python_version(),
+            "note": "in-session CPU sandbox run; not rig-comparable",
+        },
+        "env": env,
+        "tail": tail,
+        "parsed": parsed,
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    print(f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

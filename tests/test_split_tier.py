@@ -1,6 +1,7 @@
-"""The middle tier (VERDICT round-1 item 4): conflict-partitioned hazard
-batches — the fast-eligible majority runs vectorized, only the hazard
-residue pays the serial scan, results bit-exact against the oracle."""
+"""The middle tier: conflict-scheduled hazard batches (HazardTracker.plan
++ DeviceLedger._execute_waves) — wave-eligible lanes run vectorized in
+dependency-ordered waves, only the residue the masked kernels cannot
+express pays the serial scan, results bit-exact against the oracle."""
 
 import pytest
 
@@ -34,7 +35,7 @@ def _check(oracle, dev, ts, transfers, expect_decision=None):
         probe.pending_accounts = dict(dev.hazards.pending_accounts)
         probe.limit_account_ids = set(dev.hazards.limit_account_ids)
         probe._limit_lo = dev.hazards._limit_lo.copy()
-        decision, _ = probe.split(transfers_to_np(transfers))
+        decision, _ = probe.plan(transfers_to_np(transfers))
         assert decision == expect_decision, decision
     ts += len(transfers)
     dense_o = oracle.execute_dense(Operation.create_transfers, ts, transfers)
@@ -82,7 +83,7 @@ def test_split_mixed_two_phase_batch():
                  credit_account_id=13 + i % 8, amount=2 + i, ledger=1, code=1)
         for i in range(16)
     ]
-    ts = _check(oracle, dev, ts, transfers2, expect_decision="split")
+    ts = _check(oracle, dev, ts, transfers2, expect_decision="waves")
     assert dev.hazards.split_stats["split"] >= 1
 
 
@@ -126,13 +127,13 @@ def test_split_balancing_residue():
     ts = _check(oracle, dev, ts, transfers)
 
 
-def test_split_unknown_pending_ref_joins_residue():
-    """In a PARTIAL split, a post referencing a pending the tracker never
-    saw (e.g. created before a restart) cannot prove account-disjointness
-    from the fast half — it must join the serial residue. (In a full-batch
-    fast_pv there is no disjointness requirement: the kernel reads the
-    pending's truth from the table.)"""
-    tracker = HazardTracker()
+def test_plan_unknown_pending_ref_vs_order_sensitive_accounts():
+    """A post referencing a pending the tracker never saw (e.g. created
+    before a restart) has unprovable balance targets. With NO
+    order-sensitive accounts (no balance limits, no balancing lanes) its
+    effects commute, so it stays on the wave path — the kernel reads the
+    pending's truth from the table. The moment order-sensitive accounts
+    exist, it must join the serial residue."""
     transfers = [
         # a chain -> guarantees a residue exists
         Transfer(id=890, debit_account_id=30, credit_account_id=31, amount=1,
@@ -147,10 +148,20 @@ def test_split_unknown_pending_ref_joins_residue():
         Transfer(id=950, pending_id=424242,  # a pending we never saw
                  flags=int(TransferFlags.post_pending_transfer)),
     ]
-    decision, mask = tracker.split(transfers_to_np(transfers))
-    assert decision == "split"
-    assert mask[0] and mask[1]  # the chain
-    assert mask[-1]  # the unknown-pending post joined the residue
+    arr = transfers_to_np(transfers)
+    tracker = HazardTracker()
+    decision, plan = tracker.plan(arr)
+    assert decision == "waves"
+    assert plan.wave_of[0] < 0 and plan.wave_of[1] < 0  # the chain
+    assert plan.wave_of[-1] >= 0  # commuting effects: stays on a wave
+
+    limited = HazardTracker()
+    limited.limit_account_ids = {77}
+    import numpy as np
+    limited._limit_lo = np.array([77], dtype=np.uint64)
+    decision2, plan2 = limited.plan(arr)
+    assert decision2 == "waves"
+    assert plan2.wave_of[-1] < 0  # unprovable targets join the residue
 
 
 def test_fast_pv_pure_post_batch():

@@ -536,6 +536,10 @@ def cmd_start(args) -> int:
                 "window_us": replica.fuse_window_ns // 1000,
                 "autotune": replica.fuse_autotune,
             },
+            # the conflict-wave planner's decision counters (plan_stats);
+            # the "split" key name is the DEPRECATED dashboard surface —
+            # the dict carries both the wave keys (waves/wave_dispatches/
+            # residue_events/chain_len_max) and the legacy split keys
             "split": dict(hz.split_stats) if hz is not None else {},
             "pool_dropped": bus.pool.dropped,
             "loop": {

@@ -352,6 +352,17 @@ CATALOG = {
     "commit.group.fused_groups": ("counter", "groups", "fused group dispatches"),
     "commit.group.fuse_holds": ("counter", "", "fuse-window holds opened on a short run"),
     "commit.group.fuse_expired": ("counter", "", "holds expired with the run still short"),
+    "commit.group.wave_ops": ("counter", "ops", "ops committed via the conflict-wave scheduler"),
+    "commit.group.wave_dispatches": (
+        "counter", "waves", "waves dispatched across wave-scheduled ops"
+    ),
+    # conflict-wave scheduler (models/ledger.py HazardTracker.plan +
+    # DeviceLedger._execute_waves)
+    "waves.batches": ("counter", "", "batches executed through the wave scheduler"),
+    "waves.per_batch": ("histogram", "waves", "dependency-ordered waves per scheduled batch"),
+    "waves.chain_len_max": ("gauge", "waves", "deepest dependency chain wave-executed so far"),
+    "waves.occupancy": ("gauge", "", "active-lane fraction per wave of the last scheduled batch"),
+    "waves.residue_events": ("counter", "events", "events that fell to the serial residue"),
     "replica.quorum_wait_us": ("histogram", "us", "prepare broadcast -> replication quorum"),
     "replica.fuse_hold_us": ("histogram", "us", "group-commit fuse-window hold duration"),
     "replica.commit_dispatch_us": ("histogram", "us", "host time staging+launching one commit"),

@@ -400,6 +400,21 @@ class DualLedger:
         post["flags"] = 4
         ts += n
         scratch.execute_async(Operation.create_transfers, ts, post)
+        # conflict-wave scheduler: a same-batch pend->post batch compiles
+        # the scanned 2-wave stepper (the smallest _WAVE_BUCKETS shape) so
+        # a dependent-transfer burst doesn't stall the apply loop on a
+        # compile; deeper buckets compile on demand behind the queue
+        half = n // 2
+        if half >= 2:
+            wav = simple(5_000_000)
+            wav["flags"][:half] = 2  # pendings
+            wav["pending_id_lo"][half : 2 * half] = wav["id_lo"][:half]
+            wav["debit_account_id_lo"][half : 2 * half] = 0
+            wav["credit_account_id_lo"][half : 2 * half] = 0
+            wav["amount_lo"][half : 2 * half] = 0
+            wav["flags"][half : 2 * half] = 4  # posts of same-batch pendings
+            ts += n
+            scratch.execute_async(Operation.create_transfers, ts, wav)
         # both fused group capacities (the replica's group commit) + the
         # fused group-fold kernel over each (ring variant in follower
         # mode — the production apply path dispatches that one)

@@ -31,6 +31,17 @@ see ops/hashtable.py for why data-dependent control flow is banned):
   two-phase post/void (reference: :907-1014), balancing clamps, in-batch
   duplicate ids.
 
+Between the two sits **conflict-wave scheduling** (HazardTracker.plan +
+DeviceLedger._execute_waves): a batch with TRUE dependencies — duplicate
+ids, post/voids of same-batch pendings, touches of balance-limit
+accounts — is partitioned into dependency-ordered waves, each a masked
+fast/fast_pv pass over the same uploaded batch, dispatched in one scanned
+launch; only lanes the masked kernels cannot express (linked chains,
+balancing, unresolvable pending refs against order-sensitive accounts,
+chains deeper than WAVE_CAP) fall to a compacted serial residue. The wave
+layout is a deterministic pure function of the batch bytes + tracker
+state, so replicas and the simulator plan identically.
+
 Both tiers call the same validation ladders (models/validate.py), so result
 codes are bit-exact against the oracle (models/oracle.py) on every path.
 
@@ -68,7 +79,14 @@ from tigerbeetle_tpu.constants import (
 from tigerbeetle_tpu.lsm import groove as groove_fields
 from tigerbeetle_tpu.metrics import NULL_METRICS
 from tigerbeetle_tpu.models import validate
-from tigerbeetle_tpu.models.validate import F_LINKED, F_PENDING, F_POST, F_VOID
+from tigerbeetle_tpu.models.validate import (
+    F_BAL_CR,
+    F_BAL_DR,
+    F_LINKED,
+    F_PENDING,
+    F_POST,
+    F_VOID,
+)
 from tigerbeetle_tpu.ops import hashtable as ht
 from tigerbeetle_tpu.ops import u128
 from tigerbeetle_tpu.tracer import NULL_TRACER
@@ -82,9 +100,27 @@ I32 = jnp.int32
 # (sharded ledger): linked | post | void | balancing_debit |
 # balancing_credit. Only no-flag and pending-only events are fast-tier-safe.
 _SLOW_FLAGS = 0b111101
-# The split executor's slow flags: post/void are fast-eligible there (the
-# fast_pv kernel handles them); linked and balancing remain serial-only.
-_SPLIT_SLOW_FLAGS = 0b110001
+
+# ----------------------------------------------------------------------
+# conflict-wave scheduling (HazardTracker.plan / DeviceLedger._execute_waves)
+# ----------------------------------------------------------------------
+# Deepest dependency chain the wave path executes; lanes past the cap fall
+# to the serial residue (each wave costs a full-batch kernel pass, so past
+# ~this depth the exact scan is cheaper anyway).
+WAVE_CAP = 24
+# Longest-path propagation sweeps before the planner gives up and takes
+# the whole-batch serial escape hatch (multi-key entanglement deeper than
+# this is adversarial, not a workload).
+_WAVE_SWEEPS = 8
+# Compiled wave-count variants: a plan's wave count pads up to the next
+# bucket with all-false (no-op) masks so the scanned wave stepper compiles
+# a handful of shapes, not one per observed depth.
+_WAVE_BUCKETS = (2, 3, 4, 6, 8, 12, 16, WAVE_CAP)
+_WAVE_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+# Distinct multiplier for the order-sensitive ACCOUNT key namespace; a
+# cross-namespace hash collision with an id/pending-id key only ADDS a
+# conflict edge (conservative), never drops one.
+_WAVE_GOLDEN2 = np.uint64(0xC2B2AE3D27D4EB4F)
 
 ROW_WORDS = 32  # 128-byte wire rows as u32 words
 
@@ -547,7 +583,7 @@ class LedgerKernels:
         self.commit_accounts = jax.jit(
             self._commit_accounts, static_argnames=("mode",), donate_argnums=(0,)
         )
-        # Residue entry for the SPLIT executor: the serial scan over a
+        # Residue entry for the WAVE executor: the serial scan over a
         # compacted hazard residue with explicit per-event timestamps.
         self.commit_transfers_residue = jax.jit(
             lambda state, ev, n: self._serial_transfers_core(
@@ -613,8 +649,9 @@ class LedgerKernels:
         """Returns (state', results u32 [B]). `mode` is chosen by the HOST:
         "fast" for host-proven hazard-free batches, "fast_pv" when the batch
         additionally carries fast-eligible post/void events (distinct,
-        registry-known pendings — see HazardTracker.split), "serial" for
-        the exact scan."""
+        registry-known pendings, or waves ordered after their in-batch
+        creators — see HazardTracker.plan), "serial" for the exact
+        scan."""
         if mode == "serial":
             return self._serial_transfers(state, ev, n, timestamp)
         assert mode in ("fast", "fast_pv"), mode
@@ -625,7 +662,7 @@ class LedgerKernels:
         e = unpack_transfer(rows_b)
         lane = jnp.arange(B, dtype=I32)
         valid = lane < n
-        if "mask" in ev:  # split executor: the hazard residue runs serial
+        if "mask" in ev:  # wave executor: only this wave's lanes are live
             valid = valid & ev["mask"]
         ts_vec = timestamp - n.astype(U64) + lane.astype(U64) + jnp.uint64(1)
         e_a = {**e, "ts": ts_vec}
@@ -777,7 +814,12 @@ class LedgerKernels:
                 jnp.where(is_post, jnp.uint32(1), jnp.uint32(2))
             )
         applied = proceed & jnp.any(ok)
-        last_ts = jnp.max(jnp.where(ok, ts_vec, jnp.uint64(0)))
+        # max, not set: wave execution dispatches this kernel out of lane
+        # order (a later wave can hold EARLIER lanes), and the split-era
+        # residue path already relied on max in the serial scan
+        last_ts = jnp.maximum(
+            state["commit_ts"], jnp.max(jnp.where(ok, ts_vec, jnp.uint64(0)))
+        )
         return {
             **state,
             "acct_rows": acct2,
@@ -804,7 +846,7 @@ class LedgerKernels:
 
     def _serial_transfers_core(self, state, rows_b, ts_vec, n):
         """The exact scan. Timestamps are EXPLICIT per event: the full-batch
-        path passes timestamp-n+i+1; the split executor passes the residue
+        path passes timestamp-n+i+1; the wave executor passes the residue
         events' ORIGINAL batch timestamps (compaction must not change them).
         """
         B = rows_b.shape[0]
@@ -967,8 +1009,8 @@ class LedgerKernels:
             cw = jnp.where(ok, tgt_cr_slot, a_dump)
             acct_rows = acct_rows.at[dw].set(pack_account(tdr))
             acct_rows = acct_rows.at[cw].set(pack_account(tcr))
-            # max, not set: the split executor's fast half may already have
-            # committed later-lane timestamps
+            # max, not set: the wave executor's earlier waves may already
+            # have committed later-lane timestamps
             commit_ts = jnp.where(ok, jnp.maximum(commit_ts, ts), commit_ts)
 
             # --- undo log entry ---
@@ -1266,12 +1308,34 @@ def _next_pow2(n: int, floor: int = 8) -> int:
     return p
 
 
+class WavePlan:
+    """Deterministic per-batch conflict-wave layout: `wave_of[i]` is event
+    i's wave index (-1 = serial residue). Waves dispatch in index order
+    through the masked fast/fast_pv kernel — wave w+1's table lookups see
+    wave w's applied state, which is exactly the ordering the conflict
+    edges demand — and the compacted residue runs the exact serial scan
+    LAST (the entanglement closure proves it shares no ordering key with
+    any wave lane, so last is as good as any position). The layout is a
+    pure function of the batch bytes plus the tracker's committed-history
+    state (no seeds, no wall clock, no unordered iteration), so every
+    replica and the simulator plan the same batch identically."""
+
+    __slots__ = ("wave_of", "n_waves", "has_pv", "residue_n")
+
+    def __init__(self, wave_of: np.ndarray, n_waves: int, has_pv: bool):
+        self.wave_of = wave_of
+        self.n_waves = n_waves
+        self.has_pv = has_pv  # any post/void among the wave lanes
+        self.residue_n = int((wave_of < 0).sum())
+
+
 class HazardTracker:
     """Host-side, EXACT fast-tier admission control. Tracks the two facts
     that cannot be read off a batch alone — balance-limit account ids and the
-    running amount-sum overflow bound — and decides per batch whether the
-    vectorized tier is sound (see the module docstring's fast-tier list).
-    Shared by the single-chip DeviceLedger and the sharded ledger."""
+    running amount-sum overflow bound — plus the pending-accounts registry,
+    and plans each batch's execution (fast / fast_pv / conflict waves /
+    serial; see plan()). Shared by the single-chip DeviceLedger and the
+    sharded ledger."""
 
     def __init__(self):
         # Ids of accounts created with balance-limit flags (account flags are
@@ -1286,12 +1350,31 @@ class HazardTracker:
         # computes exactly.
         self.amount_sum = 0
         # Conservative superset of pending transfers ever submitted:
-        # id -> (debit lo-limb, credit lo-limb). The split executor needs
+        # id -> (debit lo-limb, credit lo-limb). The wave planner needs
         # the accounts a post/void will touch (they are the PENDING's
-        # accounts, not the event's own) to keep the fast/serial account
-        # sets disjoint.
+        # accounts, not the event's own) to order them against
+        # order-sensitive (limit/balancing) accounts.
         self.pending_accounts: dict[int, tuple[int, int]] = {}
-        self.split_stats = {"fast": 0, "serial": 0, "split": 0}
+        # Planner decision counters. New-style keys: fast / fast_pv /
+        # serial / waves (batches through the wave path) /
+        # wave_dispatches (total waves dispatched) / residue_events /
+        # chain_len_max (deepest wave count seen). Legacy keys kept for
+        # existing dashboards: every wave batch also counts as split /
+        # split_pv (the retired split executor's partial-split buckets),
+        # so fast + fast_pv + serial + split + split_pv still sums to
+        # batches processed — DEPRECATED, read `waves` instead.
+        self.plan_stats = {
+            "fast": 0, "fast_pv": 0, "serial": 0, "waves": 0,
+            "wave_dispatches": 0, "residue_events": 0, "chain_len_max": 0,
+            "split": 0, "split_pv": 0,
+        }
+
+    @property
+    def split_stats(self) -> dict:
+        """DEPRECATED compat view: the pre-wave-planner stat surface.
+        Same dict as plan_stats (a superset of the legacy keys), so
+        `dict(hz.split_stats)` keeps working for every dashboard."""
+        return self.plan_stats
 
     @staticmethod
     def has_dup_ids(arr: np.ndarray) -> bool:
@@ -1324,7 +1407,7 @@ class HazardTracker:
 
     def transfers_hazard(self, arr: np.ndarray) -> bool:
         """True if the batch needs the serial tier (all-or-nothing variant;
-        the sharded ledger uses this — the single-chip ledger uses split()).
+        the sharded ledger uses this — the single-chip ledger uses plan()).
         The running amount sum is an upper bound on any balance the store
         can hold: posts move pending to posted, voids remove, balancing
         clamps to available <= sum — counted for EVERY batch."""
@@ -1345,9 +1428,11 @@ class HazardTracker:
         return self.has_dup_ids(arr)
 
     # ------------------------------------------------------------------
-    # the SPLIT decision (middle tier): partition a batch into a fast-
-    # eligible majority and a hazard residue whose accounts/ids are provably
-    # disjoint, so running fast-then-residue preserves exact semantics
+    # the WAVE decision (middle tier): order a batch's TRUE dependencies
+    # into waves and close the serial residue under shared ORDERING KEYS
+    # only — plain shared accounts commute and create no edges (the
+    # split-era account-disjointness invariant is deliberately relaxed);
+    # running waves-then-residue preserves exact semantics (see plan())
     # ------------------------------------------------------------------
 
     def note_pending(self, arr: np.ndarray) -> None:
@@ -1363,8 +1448,8 @@ class HazardTracker:
                 )
         # Bound the registry: a pending referenced by a post/void cannot be
         # meaningfully referenced again (idempotency paths fail without
-        # touching balances) — evict it; a later stray reference degrades
-        # that batch to serial, which is always sound.
+        # touching balances) — evict it; a later stray reference moves that
+        # lane to the residue (or the batch to serial), always sound.
         pv = (arr["flags"] & np.uint16(F_POST | F_VOID)) != 0
         if pv.any():
             for pl, ph in zip(
@@ -1372,141 +1457,255 @@ class HazardTracker:
             ):
                 self.pending_accounts.pop(int(pl) | (int(ph) << 64), None)
 
-    def split(self, arr: np.ndarray):
-        """Per-batch tier decision: ("fast"|"fast_pv"|"serial", None) or
-        ("split"|"split_pv", slow_mask). Post/void events are FAST-eligible
-        (the fast_pv kernel gathers the pending row + its accounts and
-        applies signed balance deltas) when their pending references are
-        distinct and not created within this batch; linked chains (whole
-        runs incl. terminators), balancing, duplicate-id groups, and
-        limit-account touches of SIMPLE lanes form the residue, closed
-        under shared accounts/ids by fixpoint. (Posts/voids perform no
-        limit checks — reference: src/state_machine.zig:907-1014 — so limit
-        accounts do not exclude them.)"""
+    def plan(self, arr: np.ndarray):
+        """Per-batch tier decision, the conflict-wave planner: returns
+        ("fast"|"fast_pv"|"serial", None) or ("waves", WavePlan).
+
+        A deterministic (seed-free, sorted — a pure function of the batch
+        bytes and this tracker's committed-history state) conflict index
+        orders only the TRUE dependencies of a batch:
+
+        - same-id groups (duplicate creates: exists-check order);
+        - pending-id references (post/void after its in-batch creator;
+          competing resolves of one pending in first-wins order);
+        - order-sensitive ACCOUNTS: balance-limit accounts (their
+          validation reads the running balance) and the accounts of
+          balancing lanes (their clamp reads the running balance), so
+          every touch of such an account is ordered. Plain hot accounts
+          create NO edges — balance adds commute and non-limit validation
+          never reads a balance, which is what lets a one-hot-account
+          batch run in ~dependency-chain-length waves instead of a
+          whole-batch serial scan.
+
+        Lanes the masked fast/fast_pv kernels cannot express — linked
+        chains (rollback), balancing (balance-dependent amount), and
+        unresolvable pending references when order-sensitive accounts
+        exist — form the serial RESIDUE, closed so it shares no ordering
+        key with any wave lane (then running it after the waves preserves
+        every cross ordering). Post/voids perform no limit checks
+        themselves (reference: src/state_machine.zig:907-1014)."""
         # exact overflow bound, counted once per batch (see transfers_hazard)
         self.amount_sum += self._batch_amount_sum(arr)
+        st = self.plan_stats
         if self.amount_sum >= (1 << 127):
-            self.split_stats["serial"] += 1
+            st["serial"] += 1
             return "serial", None
 
         B = len(arr)
         flags = arr["flags"]
-        slow = (flags & np.uint16(_SPLIT_SLOW_FLAGS)) != 0
-        # whole chain runs: a linked run's terminator is the event AFTER it
+        pv = (flags & np.uint16(F_POST | F_VOID)) != 0
+        any_pv = bool(pv.any())
+        bal = (flags & np.uint16(F_BAL_DR | F_BAL_CR)) != 0
         linked = (flags & np.uint16(F_LINKED)) != 0
+        # whole chain runs: a linked run's terminator is the event AFTER it
         in_chain = linked.copy()
         in_chain[1:] |= linked[:-1]
-        slow |= in_chain
-        # duplicate ids: conservative hash groups (collisions only add lanes)
+        residue = in_chain | bal
+
         with np.errstate(over="ignore"):
-            h = arr["id_lo"] ^ (arr["id_hi"] * np.uint64(0x9E3779B97F4A7C15))
-        slow |= self._dup_groups(h)
-        pv = (flags & np.uint16(F_POST | F_VOID)) != 0
-        # limit-account touches (simple lanes only: post/void is exempt)
-        if self.limit_account_ids:
-            slow |= self._touches_limit(arr) & ~pv
+            h_id = arr["id_lo"] ^ (arr["id_hi"] * _WAVE_GOLDEN)
+        dup = self._dup_groups(h_id)
 
-        pv_live = pv & ~slow
-        extra_acc: list[int] = []
-        if pv_live.any():
-            # duplicate pending references are order-dependent (33/34 codes)
-            with np.errstate(over="ignore"):
-                hp = arr["pending_id_lo"] ^ (
-                    arr["pending_id_hi"] * np.uint64(0x9E3779B97F4A7C15)
+        # -- fast exits: hazard-free batches pay only what they always paid
+        if not residue.any() and not dup.any():
+            limit_touch = (
+                self._touches_limit(arr)
+                if self.limit_account_ids
+                else None
+            )
+            if not any_pv:
+                if limit_touch is None or not limit_touch.any():
+                    st["fast"] += 1
+                    return "fast", None
+            else:
+                with np.errstate(over="ignore"):
+                    hp = arr["pending_id_lo"] ^ (
+                        arr["pending_id_hi"] * _WAVE_GOLDEN
+                    )
+                # distinct pending refs, none created in this batch, no
+                # limit-account touches by simple lanes: the whole batch
+                # is one fast_pv wave (the kernel reads each pending's
+                # truth — row, accounts, fulfill — from the table)
+                hpc = hp.copy()
+                hpc[~pv] = np.uint64(0) - np.arange(1, B + 1)[~pv].astype(
+                    np.uint64
                 )
-            hp = hp.copy()
-            hp[~pv] = np.uint64(0) - np.arange(1, B + 1)[~pv].astype(np.uint64)
-            slow |= self._dup_groups(hp) & pv
-            # a post/void of a pending CREATED IN THIS BATCH is order-
-            # dependent: both the reference and the creator go serial
-            # (conservative lo-limb matching)
-            pid_lo = arr["pending_id_lo"]
-            in_batch_ref = np.isin(pid_lo, arr["id_lo"]) & pv
-            if in_batch_ref.any():
-                slow |= in_batch_ref
-                slow |= np.isin(arr["id_lo"], pid_lo[in_batch_ref])
-            pv_live = pv & ~slow
+                if (
+                    not (self._dup_groups(hpc) & pv).any()
+                    and not np.isin(hp[pv], h_id).any()
+                    and (limit_touch is None or not (limit_touch & ~pv).any())
+                ):
+                    st["fast_pv"] += 1
+                    return "fast_pv", None
 
-        if slow.all():
-            self.split_stats["serial"] += 1
-            return "serial", None
-        if not slow.any():
-            name = "fast_pv" if pv.any() else "fast"
-            self.split_stats[name] = self.split_stats.get(name, 0) + 1
-            return name, None
+        # -- general path: conflict index over ordering keys --
+        with np.errstate(over="ignore"):
+            h_pid = arr["pending_id_lo"] ^ (
+                arr["pending_id_hi"] * _WAVE_GOLDEN
+            )
+        pv_idx = np.nonzero(pv)[0]
 
-        # PARTIAL split: fast pv lanes' balance effects hit the PENDING's
-        # accounts — needed for the disjointness fixpoint. Unknown pendings
-        # (not in the registry) move to the residue (the exact scan handles
-        # them); invalid references (0/max -> validation fails with no
-        # balance effect) stay fast.
-        dr = arr["debit_account_id_lo"].astype(np.uint64).copy()
-        cr = arr["credit_account_id_lo"].astype(np.uint64).copy()
-        if pv_live.any():
-            for i in np.nonzero(pv_live)[0]:
+        # order-sensitive accounts (lo limbs; a collision only ADDS edges)
+        sens = [self._limit_lo]
+        if bal.any():
+            sens.append(arr["debit_account_id_lo"][bal].astype(np.uint64))
+            sens.append(arr["credit_account_id_lo"][bal].astype(np.uint64))
+        sens_lo = np.unique(np.concatenate(sens))
+
+        # pv lanes mutate their PENDING's accounts, not their own: resolve
+        # those targets (registry, else the in-batch creator) so the
+        # order-sensitive account edges are complete. Only needed when
+        # order-sensitive accounts exist at all — otherwise pv balance
+        # effects commute with everything and need no account edges.
+        eff_dr = arr["debit_account_id_lo"].astype(np.uint64).copy()
+        eff_cr = arr["credit_account_id_lo"].astype(np.uint64).copy()
+        if len(pv_idx) and len(sens_lo):
+            for i in pv_idx:
                 pid = int(arr["pending_id_lo"][i]) | (
                     int(arr["pending_id_hi"][i]) << 64
                 )
                 if pid in (0, (1 << 128) - 1):
-                    dr[i] = 0
-                    cr[i] = 0
+                    eff_dr[i] = 0  # invalid ref: fails with no effect
+                    eff_cr[i] = 0
                     continue
                 known = self.pending_accounts.get(pid)
-                if known is None:
-                    slow[i] = True
+                if known is not None:
+                    eff_dr[i] = known[0] & ((1 << 64) - 1)
+                    eff_cr[i] = known[1] & ((1 << 64) - 1)
+                    continue
+                cre = np.nonzero(h_id == h_pid[i])[0]
+                if len(cre):
+                    # in-batch creator(s): take the first's accounts; id-dup
+                    # creators that disagree are unresolvable -> residue
+                    eff_dr[i] = int(arr["debit_account_id_lo"][cre[0]])
+                    eff_cr[i] = int(arr["credit_account_id_lo"][cre[0]])
+                    if len(cre) > 1 and (
+                        (arr["debit_account_id_lo"][cre] != eff_dr[i]).any()
+                        or (arr["credit_account_id_lo"][cre] != eff_cr[i]).any()
+                    ):
+                        residue[i] = True
                 else:
-                    dr[i] = known[0] & ((1 << 64) - 1)
-                    cr[i] = known[1] & ((1 << 64) - 1)
-        # residue pvs' pending accounts join the residue account set
-        for i in np.nonzero(pv & slow)[0]:
-            pid = int(arr["pending_id_lo"][i]) | (
-                int(arr["pending_id_hi"][i]) << 64
-            )
-            known = self.pending_accounts.get(pid)
-            if known is not None:
-                extra_acc.append(known[0] & ((1 << 64) - 1))
-                extra_acc.append(known[1] & ((1 << 64) - 1))
-        # residue post/voids referencing FAST ids: those fast events move
-        pid_set = {
-            int(a) | (int(b) << 64)
-            for a, b in zip(
-                arr["pending_id_lo"][pv & slow], arr["pending_id_hi"][pv & slow]
-            )
-        }
-        if pid_set:
-            ref = np.fromiter(
-                (
-                    (int(a) | (int(b) << 64)) in pid_set
-                    for a, b in zip(arr["id_lo"], arr["id_hi"])
-                ),
-                dtype=bool, count=B,
-            )
-            slow |= ref
+                    # unknown pending (e.g. registry evicted, or created
+                    # before a restart): its balance targets cannot be
+                    # proven clear of the order-sensitive set
+                    eff_dr[i] = 0
+                    eff_cr[i] = 0
+                    residue[i] = True
 
-        # account-disjointness fixpoint (lo limbs; collisions conservative)
-        extra = np.array(extra_acc, dtype=np.uint64)
+        # (lane, key) conflict-edge list. Id keys only for lanes in a
+        # duplicate group or referenced by a pv's pending id (a unique,
+        # unreferenced id orders nothing).
+        dup_or_ref = dup
+        if len(pv_idx):
+            dup_or_ref = dup | np.isin(h_id, h_pid[pv_idx])
+        idk = np.nonzero(dup_or_ref)[0]
+        lanes_e = [idk]
+        keys_e = [h_id[idk]]
+        if len(pv_idx):
+            lanes_e.append(pv_idx)
+            keys_e.append(h_pid[pv_idx])
+        if len(sens_lo):
+            with np.errstate(over="ignore"):
+                for side in (eff_dr, eff_cr):
+                    t_idx = np.nonzero(np.isin(side, sens_lo))[0]
+                    if len(t_idx):
+                        lanes_e.append(t_idx)
+                        keys_e.append(side[t_idx] * _WAVE_GOLDEN2 + np.uint64(1))
+        lane_e = np.concatenate(lanes_e)
+        key_e = np.concatenate(keys_e)
+
+        # -- residue entanglement closure: a wave lane sharing ANY ordering
+        # key with a residue lane joins the residue (it runs LAST; a shared
+        # key across that boundary would reorder a true dependency). Plain
+        # account collisions never propagate — this closure is what keeps
+        # hot accounts on the wave path.
         for _ in range(64):
-            if slow.all():
+            if not len(lane_e) or residue.all():
                 break
-            r_acc = np.unique(np.concatenate([dr[slow], cr[slow], extra]))
-            move = ~slow & (np.isin(dr, r_acc) | np.isin(cr, r_acc))
+            on_res = residue[lane_e]
+            if not on_res.any():
+                break
+            tainted = np.unique(key_e[on_res])
+            move = ~on_res & np.isin(key_e, tainted)
             if not move.any():
                 break
-            slow |= move
+            residue[lane_e[move]] = True
         else:
-            self.split_stats["serial"] += 1
+            st["serial"] += 1
             return "serial", None
 
-        # (the fixpoint only ever grows `slow`, so at least one slow lane
-        # remains here)
-        n_fast = int((~slow).sum())
-        if n_fast < max(8, B // 8):
-            # too little fast work to pay for two dispatches
-            self.split_stats["serial"] += 1
+        wl = ~residue
+        if int(wl.sum()) < max(8, B // 8):
+            # too little wave work to pay for the extra dispatches
+            st["serial"] += 1
             return "serial", None
-        name = "split_pv" if (pv & ~slow).any() else "split"
-        self.split_stats[name] = self.split_stats.get(name, 0) + 1
-        return name, slow
+
+        # -- wave assignment: longest dependency chain ending at each lane.
+        # Within one key group the lanes (in index order) form a chain
+        # w'_t = max(w_t, w'_{t-1} + 1) = rank_t + cummax(w_s - rank_s);
+        # a sweep applies every group's scan at once and scatter-maxes the
+        # results back per lane; sweeps iterate to the multi-key fixpoint.
+        wave = np.zeros(B, dtype=np.int64)
+        m = wl[lane_e]
+        el, ek = lane_e[m], key_e[m]
+        if len(el):
+            ko = np.lexsort((el, ek))
+            el_k, ek_k = el[ko], ek[ko]
+            E = len(el_k)
+            grp_start = np.ones(E, dtype=bool)
+            grp_start[1:] = ek_k[1:] != ek_k[:-1]
+            gid = np.cumsum(grp_start) - 1
+            pos = np.arange(E, dtype=np.int64)
+            rank = pos - pos[grp_start][gid]
+            off = gid * np.int64(2 * B + WAVE_CAP + 8)  # isolates groups
+            lo_ = np.argsort(el_k, kind="stable")
+            el_l = el_k[lo_]
+            lane_start = np.ones(E, dtype=bool)
+            lane_start[1:] = el_l[1:] != el_l[:-1]
+            starts = np.nonzero(lane_start)[0]
+            lanes_u = el_l[starts]
+            for _ in range(_WAVE_SWEEPS):
+                w_k = wave[el_k]
+                w2 = rank + np.maximum.accumulate(w_k - rank + off) - off
+                red = np.maximum.reduceat(w2[lo_], starts)
+                if (red <= wave[lanes_u]).all():
+                    break
+                wave[lanes_u] = np.maximum(wave[lanes_u], red)
+            else:
+                st["serial"] += 1  # adversarial entanglement: escape hatch
+                return "serial", None
+            # depth cap: capped lanes fall to the residue. Sound without
+            # re-running the closure — wave numbers are monotone along
+            # every key chain, so any lane ordered AFTER a capped lane is
+            # itself capped (also residue, in original order), and lanes
+            # ordered before run in earlier waves, before the residue.
+            over = wl & (wave >= WAVE_CAP)
+            if over.any():
+                residue |= over
+                wl = ~residue
+                if int(wl.sum()) < max(8, B // 8):
+                    st["serial"] += 1
+                    return "serial", None
+
+        n_waves = int(wave[wl].max()) + 1 if wl.any() else 1
+        has_res = bool(residue.any())
+        if not has_res and n_waves == 1:
+            name = "fast_pv" if any_pv else "fast"
+            st[name] += 1
+            return name, None
+        wave_of = np.where(wl, wave, -1).astype(np.int32)
+        plan = WavePlan(wave_of, n_waves, bool(pv[wl].any()))
+        st["waves"] += 1
+        st["wave_dispatches"] += n_waves
+        st["residue_events"] += plan.residue_n
+        st["chain_len_max"] = max(st["chain_len_max"], n_waves)
+        # legacy dashboard keys (deprecated, see plan_stats): EVERY wave
+        # batch counts toward split/split_pv so the legacy identity
+        # fast + fast_pv + serial + split + split_pv == batches still
+        # holds (a residue-free multi-wave batch is still a "partial
+        # split" to an old reader: not whole-batch fast, not serial)
+        st["split_pv" if plan.has_pv else "split"] += 1
+        return "waves", plan
 
     @staticmethod
     def _dup_groups(h: np.ndarray) -> np.ndarray:
@@ -1683,10 +1882,10 @@ class PendingBatch:
 
     __slots__ = ("operation", "n", "results", "flags", "id_limbs", "dense",
                  "epoch", "group", "group_idx", "summary", "failures",
-                 "codes_np")
+                 "codes_np", "plan")
 
     def __init__(self, operation, n, results, flags=None, id_limbs=None,
-                 epoch=0, group=None, group_idx=0, summary=None):
+                 epoch=0, group=None, group_idx=0, summary=None, plan=None):
         self.operation = operation
         self.n = n
         self.results = results  # device u32 [n_pad + 1]; last = fault word
@@ -1699,6 +1898,9 @@ class PendingBatch:
         self.summary = summary  # device [count, fault]: the cheap drain
         self.failures = None  # failure count once drained
         self.codes_np = None  # dense codes np array (failure path only)
+        # wave-planner decision plumbed to the commit dispatcher:
+        # (decision str, wave count) for create_transfers, else None
+        self.plan = plan
 
 
 class DeviceLedger(HostLedgerBase):
@@ -1812,20 +2014,22 @@ class DeviceLedger(HostLedgerBase):
                     "grow ConfigProcess.transfer_slots_log2"
                 )
             if self.mode == "auto":
-                decision, slow_mask = self.hazards.split(arr)
+                decision, wave_plan = self.hazards.plan(arr)
             else:  # forced tier (parity tests); the amount bound is unused
-                decision, slow_mask = self.mode, None
+                decision, wave_plan = self.mode, None
             self.hazards.note_pending(arr)
-            if decision in ("split", "split_pv"):
-                results = self._execute_split(
-                    arr, n, n_pad, nn, ts, timestamp, slow_mask,
-                    fast_mode="fast_pv" if decision == "split_pv" else "fast",
+            if decision == "waves":
+                results = self._execute_waves(
+                    arr, n, n_pad, nn, ts, timestamp, wave_plan
                 )
             else:
                 batch = transfers_to_batch(arr, n_pad)
                 self.state, results = self.kernels.commit_transfers(
                     self.state, batch, nn, ts, mode=decision
                 )
+            plan_info = (
+                decision, wave_plan.n_waves if wave_plan is not None else 1
+            )
             self._xfer_used += n
         elif operation == Operation.create_accounts:
             if self._acct_used + n > self._acct_limit:
@@ -1843,6 +2047,7 @@ class DeviceLedger(HostLedgerBase):
             self.state, results = self.kernels.commit_accounts(
                 self.state, batch, nn, ts, mode=mode
             )
+            plan_info = None
             self._acct_used += n
         else:
             raise AssertionError(operation)
@@ -1861,7 +2066,7 @@ class DeviceLedger(HostLedgerBase):
                 pass  # no async copy: drain pays the sync cost
         return PendingBatch(
             operation, n, results, flags=arr["flags"].copy(),
-            epoch=self._occupancy_epoch, summary=summary,
+            epoch=self._occupancy_epoch, summary=summary, plan=plan_info,
         )
 
     def _summarize_fn(self):
@@ -1884,38 +2089,93 @@ class DeviceLedger(HostLedgerBase):
             fn = self.kernels._summarize_cache = jax.jit(s)
         return fn
 
-    def _execute_split(self, arr, n, n_pad, nn, ts, timestamp: int, slow_mask,
-                       fast_mode: str = "fast"):
-        """The middle tier: the fast-eligible majority runs vectorized with
-        the residue lanes masked out, then the hazard residue runs through
-        the exact serial scan COMPACTED (cost scales with residue size, not
-        batch size) with its events' original timestamps; results scatter
-        back to original lanes. Sound by the split invariants proven in
-        HazardTracker.split."""
-        mask_np = np.zeros(n_pad, dtype=bool)
-        mask_np[:n] = ~slow_mask
-        batch = transfers_to_batch(arr, n_pad)
-        batch["mask"] = jnp.asarray(mask_np)
-        self.state, r_fast = self.kernels.commit_transfers(
-            self.state, batch, nn, ts, mode=fast_mode
-        )
+    def _wave_stepper(self, W: int, n_pad: int, mode: str):
+        """Jitted dispatch of W dependency-ordered waves over ONE uploaded
+        batch: a lax.scan over the wave masks traces the commit kernel
+        ONCE regardless of W (the _group_stepper lesson), so a multi-wave
+        batch pays a single launch, not one per wave. Each lane is active
+        in exactly one wave and inactive lanes return code 0, so the
+        per-wave results fold with an elementwise max. Cached on the
+        SHARED kernels object; W is bucketed by the caller
+        (_WAVE_BUCKETS) so only a handful of shapes ever compile."""
+        cache = getattr(self.kernels, "_wave_cache", None)
+        if cache is None:
+            cache = self.kernels._wave_cache = {}
+        fn = cache.get((W, n_pad, mode))
+        if fn is None:
+            kernels = self.kernels
 
-        idx = np.nonzero(slow_mask)[0]
-        n2 = len(idx)
-        pad2 = _next_pow2(n2)
-        rows2 = np.zeros((pad2, ROW_WORDS), dtype=np.uint32)
-        rows2[:n2] = arr.view(np.uint32).reshape(len(arr), ROW_WORDS)[idx]
-        ts2 = np.zeros(pad2, dtype=np.uint64)
-        base = timestamp - n + 1  # first event's timestamp (host int: no sync)
-        ts2[:n2] = np.uint64(base) + idx.astype(np.uint64)
-        self.state, r_res = self.kernels.commit_transfers_residue(
-            self.state,
-            {"rows": jnp.asarray(rows2), "ts": jnp.asarray(ts2)},
-            jnp.int32(n2),
+            def step(state, rows, masks, n, timestamp):
+                def body(st, mask):
+                    st, r = kernels._commit_transfers(
+                        st, {"rows": rows, "mask": mask}, n, timestamp,
+                        mode=mode,
+                    )
+                    return st, r.astype(jnp.uint32)
+
+                state, rs = jax.lax.scan(body, state, masks)
+                return state, jnp.max(rs, axis=0)
+
+            fn = cache[(W, n_pad, mode)] = jax.jit(step, donate_argnums=(0,))
+        return fn
+
+    def _execute_waves(self, arr, n, n_pad, nn, ts, timestamp: int, plan):
+        """Conflict-scheduled wave execution (the HazardTracker.plan
+        layout): the batch uploads ONCE, then the waves dispatch in
+        dependency order through the masked fast/fast_pv kernel — wave
+        w+1's table lookups see wave w's applied rows, the exact ordering
+        the plan's conflict edges require — and the serial residue (if
+        any) runs the exact scan COMPACTED (cost scales with residue
+        size, not batch size) with its events' ORIGINAL timestamps;
+        results scatter back to original lanes."""
+        wave_of = plan.wave_of
+        W = plan.n_waves
+        mode = "fast_pv" if plan.has_pv else "fast"
+        rows_dev = jnp.asarray(_to_rows_np(arr, n_pad))
+        wl = wave_of[:n] >= 0
+        m = self.metrics
+        m.counter("waves.batches").add()
+        m.histogram("waves.per_batch").observe(W)
+        g = m.gauge("waves.chain_len_max")
+        g.set(max(g.value, W))
+        m.gauge("waves.occupancy").set(
+            round(float(wl.sum()) / max(1, W * n), 4)
         )
-        idx_pad = np.full(pad2, n_pad, dtype=np.int32)  # OOB -> dropped
-        idx_pad[:n2] = idx
-        return self.kernels.merge_results(r_fast, r_res, jnp.asarray(idx_pad))
+        if W == 1:
+            mask_np = np.zeros(n_pad, dtype=bool)
+            mask_np[:n] = wl
+            self.state, results = self.kernels.commit_transfers(
+                self.state, {"rows": rows_dev, "mask": jnp.asarray(mask_np)},
+                nn, ts, mode=mode,
+            )
+        else:
+            Wp = next(b for b in _WAVE_BUCKETS if b >= W)
+            masks = np.zeros((Wp, n_pad), dtype=bool)  # pad waves: no-ops
+            masks[wave_of[:n][wl], np.nonzero(wl)[0]] = True
+            self.state, results = self._wave_stepper(Wp, n_pad, mode)(
+                self.state, rows_dev, jnp.asarray(masks), nn, ts
+            )
+        if plan.residue_n:
+            m.counter("waves.residue_events").add(plan.residue_n)
+            idx = np.nonzero(~wl)[0]
+            n2 = len(idx)
+            pad2 = _next_pow2(n2)
+            rows2 = np.zeros((pad2, ROW_WORDS), dtype=np.uint32)
+            rows2[:n2] = arr.view(np.uint32).reshape(len(arr), ROW_WORDS)[idx]
+            ts2 = np.zeros(pad2, dtype=np.uint64)
+            base = timestamp - n + 1  # first event's ts (host int: no sync)
+            ts2[:n2] = np.uint64(base) + idx.astype(np.uint64)
+            self.state, r_res = self.kernels.commit_transfers_residue(
+                self.state,
+                {"rows": jnp.asarray(rows2), "ts": jnp.asarray(ts2)},
+                jnp.int32(n2),
+            )
+            idx_pad = np.full(pad2, n_pad, dtype=np.int32)  # OOB -> dropped
+            idx_pad[:n2] = idx
+            results = self.kernels.merge_results(
+                results, r_res, jnp.asarray(idx_pad)
+            )
+        return results
 
     # Fixed fused-group capacities: a lax.scan over K slots traces the
     # commit kernel ONCE regardless of K (an unrolled K multiplies the
@@ -2003,17 +2263,17 @@ class DeviceLedger(HostLedgerBase):
         total = sum(len(arr) for _, arr in items)
         if self._xfer_used + total > self._xfer_limit:
             return None  # per-batch path raises the descriptive guard
-        # Probe tier decisions with rollback: split() advances the
-        # monotone amount_sum overflow bound (and split_stats), and a
+        # Probe tier decisions with rollback: plan() advances the
+        # monotone amount_sum overflow bound (and plan_stats), and a
         # rejected fusion falls back to per-batch execute_async which
-        # calls split() AGAIN — without rollback every mixed-tier window
+        # calls plan() AGAIN — without rollback every mixed-tier window
         # double-counts toward the 2^127 serial cutoff.
         sum_before = self.hazards.amount_sum
-        stats_before = dict(self.hazards.split_stats)
-        decisions = [self.hazards.split(arr) for _, arr in items]
-        if any(d != "fast" for d, _mask in decisions):
+        stats_before = dict(self.hazards.plan_stats)
+        decisions = [self.hazards.plan(arr) for _, arr in items]
+        if any(d != "fast" for d, _plan in decisions):
             self.hazards.amount_sum = sum_before
-            self.hazards.split_stats = stats_before
+            self.hazards.plan_stats = stats_before
             return None
         k = next(g for g in reversed(self.GROUP_KS) if g >= len(items))
         n_pad = self._pad_for(max(len(arr) for _, arr in items))

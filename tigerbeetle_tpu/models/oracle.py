@@ -236,6 +236,30 @@ class OracleStateMachine:
             dict(self.posted),
         )
 
+    def assert_parity(self, backend) -> None:
+        """Diff another backend's extract() surface against this oracle
+        and FAIL NAMING the first divergent object (id + both values) —
+        the wave-scheduler parity tests run adversarial thousand-event
+        batches, where a whole-dict assert's diff is unreadable."""
+        accounts, transfers, posted = backend.extract()
+        for name, got, want in (
+            ("account", accounts, self.accounts),
+            ("transfer", transfers, self.transfers),
+            ("posted", posted, self.posted),
+        ):
+            assert set(got) == set(want), (
+                f"{name} id sets differ: only-device="
+                f"{sorted(set(got) - set(want))[:4]} only-oracle="
+                f"{sorted(set(want) - set(got))[:4]}"
+            )
+            for k in sorted(want):
+                assert got[k] == want[k], (
+                    f"{name} {k}: device={got[k]} oracle={want[k]}"
+                )
+        assert backend.commit_timestamp == self.commit_timestamp, (
+            backend.commit_timestamp, self.commit_timestamp,
+        )
+
     def snapshot_bytes(self) -> bytes:
         import json
 

@@ -181,6 +181,17 @@ class StateMachine:
             )
         return (operation, self.backend.execute_async(operation, timestamp, events))
 
+    @staticmethod
+    def handle_plan(handle):
+        """The backend's wave-planner decision for a commit_async handle:
+        (decision, wave_count) — e.g. ("waves", 3) — or None when the
+        backend has no planner (oracle/native) or the op wasn't a create.
+        The replica surfaces this as commit.group.wave_* without reaching
+        into backend-specific pending types."""
+        if isinstance(handle, bytes):
+            return None
+        return getattr(handle[1], "plan", None)
+
     def commit_group_async(self, operation: Operation, batches):
         """Fuse consecutive create_transfers commits into one device
         dispatch (group commit). `batches` = [(timestamp, body), ...].

@@ -261,8 +261,12 @@ class Replica:
             # against arrival spacing (the autotune's widen signal), while
             # a high hit rate with zero holds means runs formed without
             # deferral (the window is irrelevant, not well-tuned)
+            # wave_ops/wave_dispatches: ops whose batch ran the
+            # conflict-wave scheduler (dependent transfers executed as
+            # dependency-ordered waves instead of a whole-batch serial
+            # scan), and the total waves those ops dispatched
             ("fused_ops", "solo_ops", "fused_groups", "fuse_holds",
-             "fuse_expired"),
+             "fuse_expired", "wave_ops", "wave_dispatches"),
         )
         # commit-pipeline timing histograms (metrics.py CATALOG for units)
         self._h_quorum = self.metrics.histogram("replica.quorum_wait_us")
@@ -1869,6 +1873,14 @@ class Replica:
             self.sm.prepare_timestamp = max(
                 self.sm.prepare_timestamp, header.timestamp
             )
+            # conflict-wave decision plumbed off the dispatch handle (the
+            # backend's planner ran inside commit_async): surfaced as
+            # commit.group.wave_* so the [stats] line and the bench can
+            # attribute dependent-transfer ops to the wave path
+            plan = self.sm.handle_plan(handle)
+            if plan is not None and plan[0] == "waves":
+                self.group_stats.add("wave_ops")
+                self.group_stats.add("wave_dispatches", plan[1])
         if self.commit_hook is not None:
             self.commit_hook(header, body)
         if self.aof is not None:
