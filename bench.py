@@ -856,28 +856,91 @@ def bench_failover(stage) -> dict:
     SIGKILLed mid-run — reports failover_recovery_ms (kill to first
     client reply) and the post-failover throughput ratio, with zero
     lost/duplicated transfers verified (conservation + CDC). Host-only
-    like the other live segments: the servers own the accelerator."""
-    log = lambda *a: print("[failover]", *a, file=sys.stderr)  # noqa: E731
-    try:
-        with stage("failover"):
-            from tigerbeetle_tpu.testing.chaos import run_failover
+    like the other live segments: the servers own the accelerator.
 
-            return run_failover(
-                n_sessions=int(os.environ.get("BENCH_FAILOVER_SESSIONS",
-                                              128)),
-                conns=8,
-                events_per_batch=int(
-                    os.environ.get("BENCH_FAILOVER_EVENTS", 64)
+    RETRY-ONCE: the segment drives real processes under real signals, so
+    a single scheduler flake (r06's 1-core chaos timeout) used to null
+    the artifact's failover fields for the whole round — one retry with
+    a fresh cluster keeps one flake from erasing the measurement. Both
+    attempts failing is reported as the error it is."""
+    log = lambda *a: print("[failover]", *a, file=sys.stderr)  # noqa: E731
+    last: dict = {}
+    for attempt in (1, 2):
+        try:
+            with stage("failover" if attempt == 1 else "failover_retry"):
+                from tigerbeetle_tpu.testing.chaos import run_failover
+
+                out = run_failover(
+                    n_sessions=int(
+                        os.environ.get("BENCH_FAILOVER_SESSIONS", 128)
+                    ),
+                    conns=8,
+                    events_per_batch=int(
+                        os.environ.get("BENCH_FAILOVER_EVENTS", 64)
+                    ),
+                    batches_per_session=int(
+                        os.environ.get("BENCH_FAILOVER_BATCHES", 10)
+                    ),
+                    backend=os.environ.get(
+                        "BENCH_FAILOVER_BACKEND", "native"
+                    ),
+                    jax_platform=None,  # servers inherit the rig platform
+                    # measurement mode: a CDC stream-audit failure is
+                    # REPORTED (cdc_ok/verification_error) instead of
+                    # nulling the recovery numbers — wire conservation
+                    # (zero lost/dup ledger effects) is still asserted
+                    strict_stream=False,
+                    log=log,
+                )
+            out["failover_attempts"] = attempt
+            if out.get("failover_recovery_ms") is not None:
+                return out
+            last = out  # completed but measured nothing: retry once
+            print("[failover] recovery_ms null — retrying once",
+                  file=sys.stderr)
+        except Exception as e:  # never sink the kernel benchmark
+            print(
+                f"[failover] attempt {attempt} FAILED: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            last = {"error": f"{type(e).__name__}: {e}",
+                    "failover_attempts": attempt}
+    return last
+
+
+def bench_frontier(stage) -> dict:
+    """The load/latency frontier segment (benchmark.run_frontier): an
+    offered-load ladder against one live gateway-fronted durable server
+    (default `--backend dual`) — per step, offered vs achieved tps,
+    client p50/p95/p99, the typed-shed rate, and the dominant critical-
+    path leg from the server's per-request latency anatomy. The
+    ROADMAP-item-4 artifact: it names the leg to attack first and the
+    load where the knee is. Host-only (numpy + sockets) like the other
+    live segments."""
+    log = lambda *a: print("[frontier]", *a, file=sys.stderr)  # noqa: E731
+    steps = tuple(
+        int(x) for x in os.environ.get(
+            "BENCH_FRONTIER_STEPS", "25000,50000,100000,200000,400000"
+        ).split(",") if x
+    )
+    try:
+        with stage("frontier"):
+            from tigerbeetle_tpu.benchmark import run_frontier
+
+            return run_frontier(
+                steps=steps,
+                step_s=float(os.environ.get("BENCH_FRONTIER_STEP_S", 6.0)),
+                batch=int(os.environ.get("BENCH_FRONTIER_BATCH", 2048)),
+                sessions=int(
+                    os.environ.get("BENCH_FRONTIER_SESSIONS", 32)
                 ),
-                batches_per_session=int(
-                    os.environ.get("BENCH_FAILOVER_BATCHES", 10)
-                ),
-                backend=os.environ.get("BENCH_FAILOVER_BACKEND", "native"),
-                jax_platform=None,  # servers inherit the rig's platform
+                backend=os.environ.get("BENCH_FRONTIER_BACKEND", "dual"),
+                jax_platform=None,  # the server inherits the platform
                 log=log,
             )
     except Exception as e:  # never sink the kernel benchmark
-        print(f"[failover] FAILED: {type(e).__name__}: {e}",
+        print(f"[frontier] FAILED: {type(e).__name__}: {e}",
               file=sys.stderr)
         return {"error": f"{type(e).__name__}: {e}"}
 
@@ -918,6 +981,7 @@ def main() -> None:
     e2e = bench_e2e(stage, trace=bool(trace_path))
     ingress = bench_ingress(stage)
     failover = bench_failover(stage)
+    frontier = bench_frontier(stage)
 
     import jax
     import jax.numpy as jnp
@@ -1217,6 +1281,7 @@ def main() -> None:
     # next to this script plus stderr.
     server_trace_events = e2e.pop("trace_events", None)
     detail = {"durable": e2e, "ingress": ingress, "failover": failover,
+              "frontier": frontier,
               "configs": configs,
               "stages_s": {
                   k: round(v, 2) for k, v in stages.items()
@@ -1365,6 +1430,22 @@ def main() -> None:
                     "post_failover_tps_ratio"
                 ),
                 "failover_lost_events": failover.get("lost_events"),
+                # load/latency frontier (run_frontier): per-step offered/
+                # achieved/p50/p99/shed/dominant-leg ladder — the compact
+                # headline keeps the knee + peak; full steps in detail
+                "frontier_peak_tps": frontier.get("peak_achieved_tps"),
+                "frontier_knee_tps": frontier.get(
+                    "saturation_offered_tps"
+                ),
+                "frontier_steps": [
+                    [s.get("offered_tps"), s.get("achieved_tps"),
+                     s.get("p50_ms"), s.get("p99_ms"), s.get("shed_rate"),
+                     s.get("dominant_leg")]
+                    for s in frontier.get("steps", [])
+                ],
+                "frontier_accounted_ratio": (
+                    frontier.get("breakdown") or {}
+                ).get("accounted_ratio"),
             }
         )
     )

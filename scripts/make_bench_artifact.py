@@ -33,6 +33,17 @@ def main() -> int:
         return 1
     with open(stderr_path) as f:
         tail = f.read()[-8000:]
+    # Segment-health summary: a live segment that died mid-run leaves
+    # nulls in the summary (r06's failover flake) — name the incomplete
+    # segments in the artifact itself so a null reads as "segment
+    # failed", never as "measured zero".
+    incomplete = []
+    if parsed.get("failover_recovery_ms") is None:
+        incomplete.append("failover")
+    if not parsed.get("frontier_steps"):
+        incomplete.append("frontier")
+    elif len(parsed["frontier_steps"]) < 4:
+        incomplete.append("frontier_short_ladder")
     artifact = {
         "n": 1,
         "cmd": f"env {env} python bench.py",
@@ -51,6 +62,7 @@ def main() -> int:
         },
         "env": env,
         "tail": tail,
+        "segments_incomplete": incomplete,
         "parsed": parsed,
     }
     with open(out_path, "w") as f:

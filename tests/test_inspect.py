@@ -348,3 +348,67 @@ def test_inspect_live_reads_running_server_stats(tmp_path):
     # the SIGQUIT diagnosis reached stderr/stdout
     assert "[quit] status=" in out
     assert "Current thread" in out  # faulthandler stack snapshot
+
+
+def test_inspect_live_watch_streams_flight_history(tmp_path):
+    """The flight recorder's history rides the [stats] wire command and
+    `inspect live --watch` renders it: per-interval delta entries, one
+    rates line each (JSONL with --json), against the same any-status
+    serving path as single-shot live. The SIGQUIT dump carries the
+    history too — the whole incident-replay loop against one server."""
+    import io
+
+    from tigerbeetle_tpu.inspect import inspect_live, watch_live
+
+    proc, port = _spawn_server(tmp_path)
+    try:
+        # wait for the recorder to take a couple of entries (~1/s)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            snap = inspect_live("127.0.0.1", port)
+            if len(snap.get("history") or []) >= 2:
+                break
+            time.sleep(0.3)
+        history = snap.get("history")
+        assert history and len(history) >= 2, "no flight history served"
+        for e in history:
+            assert "t" in e and "counters" in e and "gauges" in e
+        assert history[1]["dt"] is not None
+        # latency anatomy surfaces ride the same snapshot
+        assert "latency_slowest" in snap
+        assert "latency.e2e_us" in snap["metrics"]["histograms"]
+
+        # watch mode: two polls, human lines then JSONL
+        out = io.StringIO()
+        rc = watch_live("127.0.0.1", port, interval_s=1.2, count=2,
+                        out=out)
+        assert rc == 0
+        text = out.getvalue()
+        assert "t=" in text and "ops/s=" in text, text
+        out = io.StringIO()
+        watch_live("127.0.0.1", port, interval_s=1.2, count=1, out=out,
+                   as_json=True)
+        lines = [ln for ln in out.getvalue().splitlines() if ln]
+        assert lines, "json watch printed nothing"
+        for ln in lines:
+            assert "t" in json.loads(ln)
+
+        # SIGQUIT: the hang dump must carry the history ring
+        os.kill(proc.pid, signal.SIGQUIT)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if inspect_live("127.0.0.1", port)["metrics"]["counters"].get(
+                "trace.sigquit_dumps"
+            ):
+                break
+            time.sleep(0.1)
+        assert proc.poll() is None
+    finally:
+        proc.terminate()
+        out_text, _ = proc.communicate(timeout=60)
+    quit_line = next(
+        ln for ln in out_text.splitlines() if ln.startswith("[quit] stats ")
+    )
+    quit_stats = json.loads(quit_line[len("[quit] stats "):])
+    assert quit_stats.get("history"), "SIGQUIT dump lost the flight ring"
+    assert "latency_slowest" in quit_stats

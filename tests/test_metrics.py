@@ -568,3 +568,96 @@ def test_ingress_metric_names_all_cataloged():
         kind, unit, help_ = CATALOG[name]
         assert kind in ("counter", "gauge", "histogram")
         assert isinstance(unit, str) and help_
+
+
+# -- flight recorder: time-series snapshot ring ------------------------
+
+
+def test_flight_recorder_deltas_and_windowed_percentiles():
+    """Each record() entry carries counter DELTAS (zero deltas dropped),
+    raw gauges, and WINDOWED histogram percentiles computed from the
+    bucket-count deltas — the per-interval evidence a cumulative
+    snapshot cannot give (a one-interval p99 spike must show in that
+    interval's entry, not be diluted into the lifetime percentile)."""
+    from tigerbeetle_tpu.metrics import FlightRecorder
+
+    m = Metrics()
+    c = m.counter("ops")
+    h = m.histogram("lat")
+    fr = FlightRecorder(m, capacity=4)
+
+    c.add(10)
+    for _ in range(100):
+        h.observe(10.0)
+    e1 = fr.record(1.0)
+    assert e1["dt"] is None  # first entry has no previous interval
+    assert e1["counters"]["ops"] == 10
+    assert e1["histograms"]["lat"]["count"] == 100
+    assert e1["histograms"]["lat"]["p99"] <= 16.0  # all ~10us
+
+    # interval 2: a stall — few, huge observations. The WINDOWED p50
+    # must reflect only this interval, not the 100 fast ones before.
+    for _ in range(4):
+        h.observe(40_000.0)
+    e2 = fr.record(2.0)
+    assert e2["dt"] == 1.0
+    assert "ops" not in e2["counters"]  # unchanged -> dropped
+    w = e2["histograms"]["lat"]
+    assert w["count"] == 4
+    assert w["p50"] >= 32_768.0, w  # the stall dominates ITS window
+    # cumulative snapshot would bury it: lifetime p50 is still fast
+    assert m.snapshot()["histograms"]["lat"]["p50"] <= 16.0
+
+    # idle interval: no counter moves, no new observations
+    e3 = fr.record(3.0)
+    assert e3["counters"] == {} and e3["histograms"] == {}
+
+    # ring: capacity 4, oldest overwritten, history oldest-first
+    for t in range(4, 9):
+        c.add(1)
+        fr.record(float(t))
+    hist = fr.history()
+    assert len(hist) == 4
+    assert [e["t"] for e in hist] == [5.0, 6.0, 7.0, 8.0]
+    assert fr.history(last=2)[-1]["t"] == 8.0
+    # the recorder counts its own passes (CATALOG'd)
+    from tigerbeetle_tpu.metrics import CATALOG
+
+    assert m.snapshot()["counters"]["flight.records"] == 8
+    assert "flight.records" in CATALOG
+
+
+def test_statsd_histogram_percentiles_and_count_deltas():
+    """The emitter ships histogram percentile snapshots (p50/p95/p99/max
+    as gauges) plus the observation-count DELTA as a counter — and a
+    histogram with no new observations since the last flush emits
+    nothing (an idle server used to re-send every percentile forever)."""
+    sink, port = _udp_sink()
+    s = StatsD("127.0.0.1", port, prefix="tb")
+    m = Metrics()
+    h = m.histogram("commit_us")
+    for v in (100.0, 200.0, 400.0):
+        h.observe(v)
+    em = StatsDEmitter(s, m)
+    n = em.flush()
+    lines = []
+    for _ in range(n):
+        lines.extend(sink.recv(4096).decode().split("\n"))
+    assert "tb.commit_us.count:3|c" in lines
+    for stat in ("p50", "p95", "p99", "max"):
+        assert any(
+            ln.startswith(f"tb.commit_us.{stat}:") and ln.endswith("|g")
+            for ln in lines
+        ), (stat, lines)
+    # unchanged histogram -> fully suppressed (nothing else registered,
+    # so the flush sends zero datagrams)
+    assert em.flush() == 0
+    # new observations -> the count DELTA (not the absolute) goes out
+    h.observe(800.0)
+    n = em.flush()
+    lines = []
+    for _ in range(n):
+        lines.extend(sink.recv(4096).decode().split("\n"))
+    assert "tb.commit_us.count:1|c" in lines
+    s.close()
+    sink.close()

@@ -1070,6 +1070,347 @@ def run_ingress_sessions(
             tmp.cleanup()
 
 
+# ---------------------------------------------------------------------
+# frontier: offered-load ladder vs latency against a live server
+# ---------------------------------------------------------------------
+
+
+def run_frontier(
+    steps=(20_000, 50_000, 100_000, 200_000),
+    step_s: float = 6.0,
+    batch: int = 2048,
+    sessions: int = 32,
+    conns: int = 4,
+    n_accounts: int = 512,
+    backend: str = "dual",
+    sample_every: int = 1,
+    warmup_batches: int = 4,
+    drain_s: float = 60.0,
+    jax_platform: str | None = None,
+    tmpdir: str | None = None,
+    log=None,
+) -> dict:
+    """The load/latency FRONTIER segment (ROADMAP item 4's artifact):
+    step offered load across a ladder against one live gateway-fronted
+    server and report, per step, offered vs achieved tps, client-side
+    p50/p95/p99, the typed-shed rate, and the DOMINANT critical-path leg
+    from the server's per-request latency anatomy (latency.py) — "where
+    do the milliseconds go as load rises", the artifact that picks the
+    first target of the latency attack.
+
+    The driver is OPEN-LOOP: submissions are scheduled at the offered
+    rate, queue when every session is busy, and each request's latency
+    is measured from its SCHEDULED time — so saturation shows up as
+    rising latency (no coordinated omission), and typed busy sheds ride
+    the client runtime's backoff ladder like production traffic. Server-
+    side numbers come from live [stats] wire snapshots taken between
+    steps (inspect_live): counter deltas give the step's sheds, and
+    latency.* histogram deltas give its dominant leg.
+
+    The final snapshot's slowest-request breakdown proves the
+    decomposition ACCOUNTS for the time: legs are consecutive stamp
+    intervals, so sum(legs) must be within rounding of e2e
+    (`breakdown_accounted_ratio`, asserted by the frontier smoke)."""
+    import json as _json
+    from collections import deque
+
+    from tigerbeetle_tpu.inspect import inspect_live
+    from tigerbeetle_tpu.io.message_bus import TCPMessageBus
+    from tigerbeetle_tpu.latency import dominant_leg, leg_totals
+
+    log = log or (lambda *_: None)
+    own_tmp = tmpdir is None
+    if own_tmp:
+        tmp = tempfile.TemporaryDirectory(prefix="tb_frontier_")
+        tmpdir = tmp.name
+    path = os.path.join(tmpdir, "frontier.tigerbeetle")
+    port = free_port()
+    total_est = int(
+        sum(r * step_s for r in steps) * 1.5
+        + (warmup_batches + 4) * batch + sessions * batch
+    )
+    slots_log2 = 15
+    while total_est > (1 << slots_log2) // 2:
+        slots_log2 += 1
+    pp = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, PYTHONPATH=f"{REPO}:{pp}" if pp else REPO,
+               TB_PARENT_WATCHDOG="1")
+    if jax_platform:
+        env["TB_JAX_PLATFORM"] = jax_platform
+    session_args = ("--clients-max", str(sessions + 16))
+    fmt = subprocess.run(
+        [sys.executable, "-m", "tigerbeetle_tpu", "format",
+         "--cluster", "0", "--replica", "0", "--replica-count", "1",
+         *session_args, path],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert fmt.returncode == 0, fmt.stderr
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tigerbeetle_tpu", "start",
+         "--addresses", f"127.0.0.1:{port}",
+         "--account-slots-log2",
+         str(max(14, (n_accounts * 2 + 2).bit_length())),
+         "--transfer-slots-log2", str(slots_log2),
+         "--backend", backend, "--ingress",
+         "--latency-sample-every", str(sample_every),
+         *session_args, path],
+        cwd=REPO, env=env, start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    buses: list[TCPMessageBus] = []
+    try:
+        while True:
+            line = proc.stdout.readline()
+            if "listening" in line:
+                break
+            if not line:
+                raise RuntimeError("frontier server died before listening")
+            log(line.rstrip())
+        log(f"server up on :{port} backend={backend} ladder={list(steps)}")
+        server_stats: dict = {}
+
+        def _drain_stdout():
+            for out_line in proc.stdout:
+                s = out_line.rstrip()
+                if s.startswith("[stats] "):
+                    try:
+                        server_stats.update(_json.loads(s[8:]))
+                    except ValueError:
+                        pass
+                log("[server]", s)
+
+        drain_thread = threading.Thread(target=_drain_stdout, daemon=True)
+        drain_thread.start()
+
+        buses = [
+            TCPMessageBus([("127.0.0.1", port)], 0xF0000000 + b, demux=True)
+            for b in range(conns)
+        ]
+
+        def pump_all() -> None:
+            for b in buses:
+                b.pump(timeout=0.0)
+
+        fleet = [
+            _MuxSession(0xF1000000 + i, buses[i % conns])
+            for i in range(sessions)
+        ]
+        # registration (bounded window, reusing the runtime's retries)
+        t0 = time.monotonic()
+        pending = deque(fleet)
+        active: list[_MuxSession] = []
+        while pending or active:
+            now = time.monotonic()
+            if now - t0 > 120:
+                raise TimeoutError("frontier registration stalled")
+            while pending and len(active) < 64:
+                s = pending.popleft()
+                s.client.register()
+                active.append(s)
+            pump_all()
+            active = [s for s in active if not (
+                s.poll(now) and (s.client.take_reply() or True)
+            )]
+        rng = np.random.default_rng(11)
+        next_id = [1_000_000]
+
+        def transfer_body(count: int) -> bytes:
+            body = _transfers_body(rng, next_id[0], count, n_accounts)
+            next_id[0] += count
+            return body
+
+        def drive_one(s: _MuxSession, op, body, deadline=120.0) -> bytes:
+            s.client.request(op, body)
+            t_req = time.monotonic()
+            while not s.poll(time.monotonic()):
+                pump_all()
+                if time.monotonic() - t_req > deadline:
+                    raise TimeoutError("frontier control request stalled")
+            _h, rbody = s.client.take_reply()
+            return rbody
+
+        next_acct = 1
+        while next_acct <= n_accounts:
+            k = min(8190, n_accounts - next_acct + 1)
+            assert drive_one(
+                fleet[0], Operation.create_accounts,
+                _accounts_body(next_acct, k),
+            ) == b"", "account create failed"
+            next_acct += k
+        for _ in range(warmup_batches):  # engine/kernel warm, off the clock
+            assert drive_one(
+                fleet[0], Operation.create_transfers, transfer_body(batch)
+            ) == b""
+        log(f"{sessions} sessions + {n_accounts} accounts ready")
+
+        def counters(snap: dict) -> dict:
+            return snap.get("metrics", {}).get("counters", {})
+
+        out_steps: list[dict] = []
+        acked_total = 0
+        by_id = {s.client.client_id: s for s in fleet}
+        # in flight ACROSS steps: a drain-timeout leaves requests on the
+        # wire, and the next step must neither double-submit on a busy
+        # session (the client asserts one in-flight request) nor count
+        # the stale replies into its own numbers (value None = stale).
+        inflight: dict[int, float | None] = {}  # client_id -> due time
+        for rate in steps:
+            snap0 = inspect_live("127.0.0.1", port)
+            interval = batch / rate
+            t_start = time.monotonic()
+            t_end = t_start + step_s
+            due = t_start
+            backlog: deque[float] = deque()  # scheduled-but-unsubmitted
+            idle = [s for s in fleet if s.client.client_id not in inflight]
+            lat_ms: list[float] = []
+            offered = acked_win = failures = 0
+            while True:
+                now = time.monotonic()
+                if now >= t_end and not inflight and not backlog:
+                    break
+                if now - t_end > drain_s:
+                    break  # overloaded step: stop draining, report as-is
+                while due <= now and due < t_end:
+                    backlog.append(due)
+                    offered += batch
+                    due += interval
+                while backlog and idle and now < t_end + drain_s:
+                    s = idle.pop()
+                    due_t = backlog.popleft()
+                    s.client.request(
+                        Operation.create_transfers, transfer_body(batch)
+                    )
+                    inflight[s.client.client_id] = due_t
+                if now >= t_end:
+                    backlog.clear()  # never submitted: offered, not acked
+                pump_all()
+                for cid in list(inflight):
+                    s = by_id[cid]
+                    if s.poll(now):
+                        _h, rbody = s.client.take_reply()
+                        if rbody != b"":
+                            failures += 1
+                        due_t = inflight.pop(cid)
+                        idle.append(s)
+                        acked_total += batch
+                        if due_t is None:
+                            continue  # a prior step's straggler
+                        # latency is recorded for EVERY request scheduled
+                        # in the window, even those completing during the
+                        # drain — dropping the late ones would understate
+                        # p99 exactly at the knee (coordinated omission
+                        # through the back door); only window THROUGHPUT
+                        # is bounded to the step itself
+                        lat_ms.append((now - due_t) * 1e3)
+                        if now < t_end:
+                            acked_win += batch
+            # whatever is still on the wire belongs to no later step
+            for cid in inflight:
+                inflight[cid] = None
+            wall = min(time.monotonic() - t_start, step_s)
+            snap1 = inspect_live("127.0.0.1", port)
+            c0, c1 = counters(snap0), counters(snap1)
+            sheds = c1.get("ingress.shed", 0) - c0.get("ingress.shed", 0)
+            admitted = (
+                c1.get("ingress.admitted", 0)
+                - c0.get("ingress.admitted", 0)
+            )
+            leg, share = dominant_leg(
+                leg_totals(snap0.get("metrics", {})),
+                leg_totals(snap1.get("metrics", {})),
+            )
+            pct = (
+                np.percentile(lat_ms, [50, 95, 99])
+                if lat_ms else [float("nan")] * 3
+            )
+            step = {
+                "offered_tps": rate,
+                "achieved_tps": round(acked_win / wall, 1) if wall else 0.0,
+                "offered_events": offered,
+                "acked_events_in_window": acked_win,
+                "p50_ms": round(float(pct[0]), 3),
+                "p95_ms": round(float(pct[1]), 3),
+                "p99_ms": round(float(pct[2]), 3),
+                "sheds": sheds,
+                "shed_rate": (
+                    round(sheds / (sheds + admitted), 4)
+                    if sheds + admitted else 0.0
+                ),
+                "dominant_leg": leg,
+                "dominant_leg_share": share,
+                "failures": failures,
+            }
+            out_steps.append(step)
+            log(f"step {rate}/s: achieved {step['achieved_tps']}/s "
+                f"p50={step['p50_ms']}ms p99={step['p99_ms']}ms "
+                f"shed_rate={step['shed_rate']} dominant={leg}")
+            assert failures == 0, f"{failures} transfer batches failed"
+
+        # decomposition accounting proof: the slowest sampled request's
+        # legs are consecutive intervals and must sum to its e2e
+        final = inspect_live("127.0.0.1", port)
+        breakdown = None
+        slowest = final.get("latency_slowest") or []
+        if slowest:
+            rec = slowest[0]
+            legs_sum = sum(rec.get("legs", {}).values())
+            breakdown = {
+                "e2e_us": rec.get("e2e_us"),
+                "legs": rec.get("legs"),
+                "dominant": rec.get("dominant"),
+                "sum_legs_us": round(legs_sum, 3),
+                "accounted_ratio": (
+                    round(legs_sum / rec["e2e_us"], 4)
+                    if rec.get("e2e_us") else None
+                ),
+            }
+        achieved = [s["achieved_tps"] for s in out_steps]
+        peak = max(achieved) if achieved else 0.0
+        knee = None
+        for s in out_steps:
+            if s["achieved_tps"] < 0.9 * s["offered_tps"]:
+                knee = s["offered_tps"]
+                break
+        proc.terminate()
+        try:
+            proc.wait(timeout=650 if backend == "dual" else 30)
+        except subprocess.TimeoutExpired:
+            pass
+        drain_thread.join(timeout=5)
+        out = {
+            "backend": backend,
+            "batch": batch,
+            "step_s": step_s,
+            "sessions": sessions,
+            "sample_every": sample_every,
+            "steps": out_steps,
+            "peak_achieved_tps": peak,
+            "saturation_offered_tps": knee,
+            "breakdown": breakdown,
+            "acked_events": acked_total,
+        }
+        if backend == "dual" and server_stats:
+            shadow = server_stats.get("device_shadow") or {}
+            out["device_shadow_verified"] = shadow.get("verified")
+        return out
+    finally:
+        for b in buses:
+            try:
+                b.sel.close()
+            except Exception:
+                pass
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        kill_process_group(proc)
+        if own_tmp:
+            tmp.cleanup()
+
+
 def _verify_and_report(session, n_accounts, total, wall, n_timed, lat_ms,
                        clients, log) -> dict:
     from tigerbeetle_tpu.state_machine import decode_accounts, encode_ids

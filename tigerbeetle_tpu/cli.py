@@ -128,6 +128,17 @@ class StartArgs:
     # sink pauses only itself (ingress/fanout.py). Default keeps the
     # PR-4 behavior: one pump, one cursor, all sinks move together.
     cdc_fanout: bool = False
+    # Per-request critical-path attribution (tigerbeetle_tpu/latency.py):
+    # one request in N is stamped at every pipeline leg and folded into
+    # the latency.* histograms at reply egress; the slowest sampled
+    # requests keep full breakdowns (SIGQUIT dump + `inspect live`).
+    # 1 = every request (regression hunting), 0 = off.
+    latency_sample_every: int = 16
+    # Flight recorder (metrics.py FlightRecorder): seconds between
+    # time-series snapshots of the registry (counter deltas + windowed
+    # histogram percentiles), ring of ~180 entries served through the
+    # [stats] wire command (`inspect live --watch`). 0 disables.
+    flight_interval_s: float = 1.0
 
 
 @dataclasses.dataclass
@@ -155,6 +166,11 @@ class InspectArgs:
     slot: int = -1  # wal: restrict the scan to one slot
     addresses: str = ""  # live: host:port of the running replica
     json: bool = False  # machine-readable report
+    # live repeated-snapshot mode: re-poll every N seconds and print
+    # per-interval deltas/rates from the server's flight-recorder
+    # history (works against wedged replicas like single-shot live)
+    watch: float = 0.0
+    watch_count: int = 0  # stop after N polls (0 = until interrupted)
     # geometry the file was formatted with (same contract as `start`:
     # only non-defaults need repeating; the grid size is inferred from
     # the file size)
@@ -403,6 +419,18 @@ def cmd_start(args) -> int:
         tracer=tracer,
     )
     boot("replica constructed (device state allocated)")
+    # latency anatomy: sampling knob + TCP egress (the bus finishes a
+    # sampled record at the flush that writes its reply frame, so the
+    # reply_egress leg measures finalize -> first socket write)
+    replica.latency.sample_every = args.latency_sample_every
+    replica.latency.defer_egress = True
+    bus.latency = replica.latency
+    flight = None
+    if args.flight_interval_s > 0:
+        from tigerbeetle_tpu.metrics import FlightRecorder
+
+        flight = FlightRecorder(metrics)
+        replica.flight_recorder = flight  # [stats] wire command history
     if args.aof:
         replica.aof = AOF(args.aof)
     replica.commit_window = args.commit_window
@@ -554,6 +582,9 @@ def cmd_start(args) -> int:
             # snapshots): the bench harness and --statsd read the SAME
             # store this line is printed from
             "metrics": metrics.snapshot(),
+            # per-request breakdowns of the slowest sampled requests
+            # (latency.py): where THOSE requests' milliseconds went
+            "latency_slowest": replica.latency.slowest(limit=8),
         }
         if getattr(replica.ledger, "spill", None) is not None:
             stats["spill"] = dict(replica.ledger.spill.stats)
@@ -658,7 +689,13 @@ def cmd_start(args) -> int:
             "status": replica.status, "view": replica.view,
             "op": replica.op, "commit_min": replica.commit_min,
             "metrics": metrics.snapshot(),
+            # the incident evidence the cumulative snapshot cannot give:
+            # per-request breakdowns of the slowest sampled requests and
+            # the flight recorder's last minute of per-interval history
+            "latency_slowest": replica.latency.slowest(limit=8),
         }
+        if flight is not None:
+            snap["history"] = flight.history(last=60)
         sys.stderr.write(f"[quit] stats {_json.dumps(snap)}\n")
         sys.stderr.flush()
 
@@ -671,6 +708,7 @@ def cmd_start(args) -> int:
     last_tick = time.monotonic()
     last_debug = time.monotonic()
     last_statsd = time.monotonic()
+    last_flight = time.monotonic()
     last_commit = replica.commit_min
     while True:
         # With async commits in flight — or a fuse window holding a short
@@ -722,6 +760,12 @@ def cmd_start(args) -> int:
             if emitter is not None and now - last_statsd >= 1.0:
                 last_statsd = now
                 emitter.flush()
+            # flight recorder: one time-series entry per interval —
+            # counter deltas + windowed histogram percentiles, the
+            # history `inspect live --watch` and the SIGQUIT dump read
+            if flight is not None and now - last_flight >= args.flight_interval_s:
+                last_flight = now
+                flight.record(now)
         if debug and now - last_debug >= 1.0:
             last_debug = now
             print(
@@ -862,6 +906,12 @@ def cmd_inspect(args) -> int:
         host, sep, port = args.addresses.strip().rpartition(":")
         if not sep or not port.isdigit():
             flags.fatal("inspect live needs --addresses host:port")
+        if args.watch > 0:
+            return _inspect.watch_live(
+                host or "127.0.0.1", int(port), interval_s=args.watch,
+                count=args.watch_count, out=sys.stdout,
+                as_json=args.json,
+            )
         report = _inspect.inspect_live(host or "127.0.0.1", int(port))
         emit("live", report)
         return 0

@@ -590,6 +590,107 @@ def inspect_live(host: str, port: int, timeout: float = 5.0) -> dict:
             buf += chunk
 
 
+def _watch_line(e: dict) -> str:
+    """One flight-recorder entry as a compact rates line: committed
+    ops/s, frames/s, sheds/s, and the interval's windowed p99 for the
+    end-to-end + commit-dispatch latency histograms (the per-interval
+    evidence a cumulative snapshot buries)."""
+    dt = e.get("dt") or 1.0
+    c = e.get("counters", {})
+    h = e.get("histograms", {})
+
+    def rate(name):
+        return c.get(name, 0) / dt
+
+    parts = [
+        f"t={e.get('t', 0):.1f}s",
+        f"ops/s={rate('server.ops_committed'):.0f}",
+        f"frames/s={rate('bus.frames'):.0f}",
+    ]
+    shed = rate("ingress.shed")
+    if shed:
+        parts.append(f"sheds/s={shed:.0f}")
+    for short, name in (
+        ("e2e", "latency.e2e_us"),
+        ("dispatch", "replica.commit_dispatch_us"),
+    ):
+        w = h.get(name)
+        if w:
+            parts.append(f"{short}_p99={w['p99']:.0f}us")
+    # the interval's dominant latency leg (largest windowed total):
+    # "where did this second's milliseconds go"
+    best, best_total = None, 0.0
+    for name, w in h.items():
+        if name.startswith("latency.") and name != "latency.e2e_us" \
+                and not name.endswith(("lag_us", "lane_us")):
+            total = w["count"] * w.get("mean", 0.0)
+            if total > best_total:
+                best, best_total = name, total
+    if best:
+        parts.append(
+            f"dominant={best[len('latency.'):-len('_us')]}"
+            f"({best_total / 1000.0:.1f}ms)"
+        )
+    gauges = e.get("gauges", {})
+    lag = gauges.get("shadow.device_lag_ops")
+    if lag:
+        parts.append(f"apply_lag={lag}")
+    return "  ".join(parts)
+
+
+def watch_live(host: str, port: int, interval_s: float = 1.0,
+               count: int = 0, out=None, as_json: bool = False,
+               sleep=None) -> int:
+    """`inspect live --watch <sec>`: poll the running replica's [stats]
+    snapshot on a cadence and print the flight-recorder entries that
+    arrived since the previous poll — per-interval deltas/rates, one
+    line each (or raw JSONL with as_json). Works against wedged
+    replicas: request_stats is answered in any status. `count` bounds
+    the polls (0 = until interrupted)."""
+    import sys as _sys
+    import time as _time
+
+    out = out or _sys.stdout
+    sleep = sleep or _time.sleep
+    last_t = None
+    polls = 0
+    try:
+        while True:
+            report = inspect_live(host, port)
+            entries = report.get("history") or []
+            fresh = [
+                e for e in entries
+                if last_t is None or (e.get("t") or 0) > last_t
+            ]
+            if entries:
+                last_t = max(e.get("t") or 0 for e in entries)
+            if not entries and polls == 0:
+                out.write(
+                    "no flight-recorder history (server started with "
+                    "--flight-interval-s 0?) — falling back to "
+                    "consensus state only\n"
+                )
+            for e in fresh:
+                if as_json:
+                    json.dump(e, out, sort_keys=True,
+                              separators=(",", ":"))
+                    out.write("\n")
+                else:
+                    out.write(_watch_line(e) + "\n")
+            if not fresh and not as_json:
+                out.write(
+                    f"status={report.get('status')} "
+                    f"commit={report.get('commit_min')} (no new history)\n"
+                )
+            out.flush()
+            polls += 1
+            if count and polls >= count:
+                return 0
+            sleep(interval_s)
+    except KeyboardInterrupt:
+        return 0
+
+
 # ----------------------------------------------------------------------
 # rendering
 # ----------------------------------------------------------------------

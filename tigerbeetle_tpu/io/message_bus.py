@@ -96,7 +96,7 @@ class MessagePool:
 class _Conn:
     __slots__ = (
         "sock", "peer", "connected", "rbuf", "roff", "wbuf",
-        "sessions", "strikes", "pending_traces",
+        "sessions", "strikes", "pending_traces", "pending_lat",
     )
 
     def __init__(self, sock: socket.socket, peer: Address | None = None,
@@ -117,6 +117,10 @@ class _Conn:
         # yet flushed — PER CONNECTION, so a flush span is tagged with
         # exactly the replies that connection's write carried
         self.pending_traces: list[int] = []
+        # latency-anatomy tokens of sampled replies queued in wbuf: the
+        # flush that writes this conn finishes their records (the
+        # reply_egress leg ends at the first socket write)
+        self.pending_lat: list[int] = []
 
 
 class TCPMessageBus(Network):
@@ -127,6 +131,13 @@ class TCPMessageBus(Network):
     # the counters exist to observe.
     tracer = NULL_TRACER
     _metrics = NULL_METRICS
+    # per-request latency anatomy (latency.py LatencyAnatomy), installed
+    # by the composition root next to `defer_egress = True`: the replica
+    # parks each sampled reply's record in `latency.pending_egress`
+    # keyed by (client, context), send() claims it for the connection
+    # that queues the reply frame, and the flush that writes the conn
+    # closes the record (reply_egress = finalize -> first socket write)
+    latency = None
 
     @property
     def metrics(self):
@@ -258,6 +269,30 @@ class TCPMessageBus(Network):
             self._c_shed_pool.add()
             return "shed_pool"  # pool exhausted: backpressure
         conn.wbuf += data
+        lat = self.latency
+        if (
+            lat is not None
+            and lat.pending_egress
+            and data[self._CMD_OFF] == _CMD_REPLY
+        ):
+            # sampled reply: claim its parked latency record for THIS
+            # conn (the key re-derives from the frame bytes — client +
+            # context — so no side channel rides the send path)
+            tok = lat.pending_egress.pop(
+                (
+                    int.from_bytes(
+                        data[self._CLIENT_OFF : self._CLIENT_OFF + 16],
+                        "little",
+                    ),
+                    int.from_bytes(
+                        data[self._CONTEXT_OFF : self._CONTEXT_OFF + 16],
+                        "little",
+                    ),
+                ),
+                None,
+            )
+            if tok is not None:
+                conn.pending_lat.append(tok)
         if self.tracer.enabled and data[self._CMD_OFF] == _CMD_REPLY:
             # the op's egress hop: tag the flush that carries this reply
             # (tracked on the CONNECTION, so the tag lands on the flush
@@ -392,6 +427,13 @@ class TCPMessageBus(Network):
         conn.sock.close()
         self.pool.credit(len(conn.wbuf))  # unsent bytes return to the pool
         conn.wbuf.clear()
+        if conn.pending_lat:
+            # replies that never reached the wire: drop their records
+            # (an egress stamp here would fabricate a latency)
+            if self.latency is not None:
+                for tok in conn.pending_lat:
+                    self.latency.discard(tok)
+            conn.pending_lat.clear()
         self._hot.pop(conn, None)
         self._links.pop(conn, None)
         # the gateway sees the close FIRST, while conn.sessions still
@@ -410,6 +452,18 @@ class TCPMessageBus(Network):
     def _flush(self, conn: _Conn) -> None:
         if not conn.connected:
             return  # dial still in progress; flushed on writability
+        self._flush_io(conn)
+        if conn.pending_lat:
+            # reply_egress closes at the flush that first attempts the
+            # socket write (a partial write still counts: the reply's
+            # bytes started onto the wire with this syscall)
+            lat = self.latency
+            if lat is not None:
+                for tok in conn.pending_lat:
+                    lat.finish(tok)
+            conn.pending_lat.clear()
+
+    def _flush_io(self, conn: _Conn) -> None:
         while conn.wbuf:
             try:
                 n = conn.sock.send(conn.wbuf)

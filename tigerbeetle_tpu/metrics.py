@@ -246,6 +246,121 @@ class Metrics:
         }
 
 
+# -- time-series flight recorder ---------------------------------------
+
+
+def _rank_percentile(counts, count: int, q: float, vmax: float) -> float:
+    """Percentile over a (delta) bucket-count vector: upper bound of the
+    bucket holding the q-quantile, clamped to `vmax` (the registry's
+    cumulative max — a window has no exact max of its own)."""
+    if count <= 0:
+        return 0.0
+    rank = q * count
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= rank:
+            return round(min(float(1 << i), vmax), 3)
+    return round(vmax, 3)
+
+
+class FlightRecorder:
+    """Fixed-capacity ring of periodic registry snapshots — the metric
+    HISTORY a cumulative snapshot cannot give: a 2-second stall inside a
+    60-second run is invisible in end-of-run totals, but jumps out of a
+    per-interval series ("commit_dispatch_us p99 jumped 40x for 3s
+    starting at t=41s").
+
+    Each record() call (the server loop drives it ~1/s) appends one
+    compact entry:
+      - counters as DELTAS since the previous entry (zero deltas
+        dropped — an idle counter costs no history bytes),
+      - gauges raw,
+      - histograms as WINDOWED percentiles computed from the bucket-
+        count deltas (only histograms that observed in the interval),
+    so an entry is a few KB and the default 180-entry ring holds ~3
+    minutes. The ring rides the `[stats]` wire command as `history`
+    (`inspect live --watch` renders it as per-second rates) and the
+    SIGQUIT hang dump.
+
+    The caller supplies the timestamp (the server loop's monotonic
+    seconds) — the recorder itself reads no clock, so it stays inert in
+    the determinism closure."""
+
+    def __init__(self, metrics: Metrics, capacity: int = 180):
+        assert capacity > 0
+        self.metrics = metrics
+        self.capacity = capacity
+        self.entries: list[dict] = []  # ring, oldest-first after unwrap
+        self._head = 0
+        self._prev_t: float | None = None
+        self._prev_counters: dict[str, float] = {}
+        # histogram window state: name -> (count, total, counts[:])
+        self._prev_hist: dict[str, tuple] = {}
+
+    def record(self, now_s: float) -> dict:
+        m = self.metrics
+        with m._lock:
+            counters = list(m._counters.items())
+            gauges = list(m._gauges.items())
+            histograms = list(m._histograms.items())
+        dt = (now_s - self._prev_t) if self._prev_t is not None else None
+        self._prev_t = now_s
+        c_delta: dict[str, float] = {}
+        for name, c in sorted(counters):
+            if name == "flight.records":
+                continue  # the recorder's own heartbeat: a constant
+                # `+1` in every entry is payload noise, not signal
+            v = c.value
+            d = v - self._prev_counters.get(name, 0)
+            if d:
+                self._prev_counters[name] = v
+                c_delta[name] = round(d, 6) if isinstance(d, float) else d
+        h_win: dict[str, dict] = {}
+        for name, h in sorted(histograms):
+            # lock-free reads (the Histogram contract): a smeared
+            # in-flight observation only staleness-skews one interval
+            count, total, vmax = h.count, h.total, h.max
+            cs = list(h.counts)
+            p_count, p_total, p_cs = self._prev_hist.get(
+                name, (0, 0.0, None)
+            )
+            dc = count - p_count
+            if dc > 0:
+                dcs = (
+                    [a - b for a, b in zip(cs, p_cs)]
+                    if p_cs is not None else cs
+                )
+                h_win[name] = {
+                    "count": dc,
+                    "mean": round((total - p_total) / dc, 3),
+                    "p50": _rank_percentile(dcs, dc, 0.50, vmax),
+                    "p95": _rank_percentile(dcs, dc, 0.95, vmax),
+                    "p99": _rank_percentile(dcs, dc, 0.99, vmax),
+                }
+                self._prev_hist[name] = (count, total, cs)
+        entry = {
+            "t": round(now_s, 3),
+            "dt": round(dt, 3) if dt is not None else None,
+            "counters": c_delta,
+            "gauges": {n: g.value for n, g in sorted(gauges)},
+            "histograms": h_win,
+        }
+        if len(self.entries) < self.capacity:
+            self.entries.append(entry)
+        else:
+            self.entries[self._head] = entry
+            self._head = (self._head + 1) % self.capacity
+        m.counter("flight.records").add()
+        return entry
+
+    def history(self, last: int = 0) -> list[dict]:
+        """Entries oldest-first (unwrapping the ring); `last` trims to
+        the newest N (the wire snapshot bounds its payload with it)."""
+        out = self.entries[self._head:] + self.entries[: self._head]
+        return out[-last:] if last else out
+
+
 # -- the zero-allocation no-op backend ---------------------------------
 
 
@@ -462,6 +577,48 @@ CATALOG = {
     "ingress.disconnect_wedged": ("counter", "conns", "wedged consumers cut at the strike limit"),
     "ingress.fanout_consumers": ("gauge", "consumers", "CDC fan-out consumers on one tail"),
     "ingress.fanout_lag_ops": ("gauge", "ops", "slowest fan-out consumer vs the watermark"),
+    # per-request critical-path attribution (tigerbeetle_tpu/latency.py;
+    # legs are CONSECUTIVE intervals, so a request's legs sum to its e2e)
+    "latency.ingress_admission_us": (
+        "histogram", "us", "arrival/gateway admit -> request admission+dedup done"
+    ),
+    "latency.wal_write_us": (
+        "histogram", "us", "prepare built + WAL write issued (sync path: completed)"
+    ),
+    "latency.quorum_wait_us": (
+        "histogram", "us", "prepare broadcast -> replication quorum reached"
+    ),
+    "latency.fuse_hold_us": (
+        "histogram", "us", "quorum-ready -> commit dispatch entry (group-fuse hold)"
+    ),
+    "latency.commit_dispatch_us": (
+        "histogram", "us", "commit dispatch (stage + device launch)"
+    ),
+    "latency.commit_wait_us": (
+        "histogram", "us", "dispatch -> finalize entry (async window / device compute)"
+    ),
+    "latency.commit_finalize_us": (
+        "histogram", "us", "finalize (WAL ack wait + drain + reply build)"
+    ),
+    "latency.reply_egress_us": (
+        "histogram", "us", "reply built -> reply leaves (bus flush / send)"
+    ),
+    "latency.e2e_us": (
+        "histogram", "us", "arrival -> reply egress (the legs above sum to this)"
+    ),
+    "latency.samples": ("counter", "requests", "requests stamped end to end"),
+    "latency.dropped": (
+        "counter", "requests", "open records evicted unfinished (shed/lost replies)"
+    ),
+    # parallel lanes (observed off the critical path, never in e2e)
+    "latency.device_apply_lag_us": (
+        "histogram", "us", "dual mode: commit finalize enqueue -> device upload"
+    ),
+    "latency.wal_lane_us": (
+        "histogram", "us", "async WAL: submit -> durable on the writer pool"
+    ),
+    # time-series flight recorder (metrics.py FlightRecorder)
+    "flight.records": ("counter", "", "flight-recorder snapshots taken"),
     # cluster-causal tracing + introspection (tracer.py, inspect.py)
     "trace.sigquit_dumps": ("counter", "", "SIGQUIT hang-diagnosis dumps taken"),
     "inspect.live_requests": ("counter", "", "live [stats] snapshots served over the wire"),

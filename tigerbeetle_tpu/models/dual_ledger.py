@@ -211,6 +211,12 @@ class DualLedger:
             self._overlap_gauge = metrics.gauge(  # vet: handoff
                 "shadow.device_apply_overlap"
             )
+            # device-apply lag lane (latency.py parallel-lane contract):
+            # bound once; observed from the APPLY thread only (the
+            # Histogram serializes internally)
+            self._h_apply_lag = metrics.histogram(  # vet: handoff
+                "latency.device_apply_lag_us"
+            )
         # the device ledger's own instrumentation (group staging
         # fence waits) reports into the same store
         self.device.instrument(metrics, tracer)
@@ -310,6 +316,9 @@ class DualLedger:
             self._lag_gauge = self.metrics.gauge("shadow.device_lag_ops")
             self._overlap_gauge = self.metrics.gauge(
                 "shadow.device_apply_overlap"
+            )
+            self._h_apply_lag = self.metrics.histogram(
+                "latency.device_apply_lag_us"
             )
         # device cannot follow a snapshot restore without an install path
         # (shadow mode, or a follower whose snapshot exceeds the device
@@ -505,7 +514,7 @@ class DualLedger:
             order (follower mode; runs are consumed in queue order so the
             chain matches the commit stream)."""
             nonlocal chk_nat
-            for op2, _o, _t, _a, codes, prep, _tr in items:
+            for op2, _o, _t, _a, codes, prep, *_rest in items:
                 chk_nat = fold_reply_codes_np(chk_nat, codes)
                 self._op_ring[op2 % APPLY_RING] = (op2, prep, chk_nat)
 
@@ -657,7 +666,7 @@ class DualLedger:
                         with self.tracer.span("shadow.upload",
                                               batches=end - i, solo=True,
                                               trace=run[i][6]):
-                            for op2, opn2, ts2, arr2, _c, _p, _tr in run[i:end]:
+                            for op2, opn2, ts2, arr2, *_rest in run[i:end]:
                                 pending = self.device.execute_async(
                                     opn2, ts2, arr2
                                 )
@@ -684,6 +693,17 @@ class DualLedger:
                     i = j
             except Exception as e:  # divergence surfaces at finalize
                 self._shadow_error = e
+            if self.follower:
+                # latency anatomy's device-apply LANE: enqueue (commit
+                # finalize, event loop) -> dispatched to the device (all
+                # of this run's uploads issued). Sampled ops only (slot 8
+                # is 0 otherwise); same perf_counter_ns domain both sides.
+                t_done = _time.perf_counter_ns()
+                for item in run:
+                    if item[7]:
+                        self._h_apply_lag.observe(
+                            (t_done - item[7]) / 1000.0
+                        )
             self._consumed_seq += len(run)
             note_applied(run[-1][0], len(run))
             if deferred_control is not None:
@@ -710,14 +730,14 @@ class DualLedger:
         the hash-log ring must localize). Whole-batch corruption — a
         single-lane flip could land on an event that was already invalid
         and change nothing."""
-        op2, opn2, ts2, arr2, codes, prep, tr = item
+        op2, opn2, ts2, arr2, codes, prep, tr, lat = item
         bad = arr2.copy()
         if opn2 == Operation.create_transfers:
             bad["debit_account_id_lo"][:] = 0xDEAD_BEEF_DEAD_BEEF
             bad["debit_account_id_hi"][:] = 0xDEAD_BEEF_DEAD_BEEF
         else:
             bad["ledger"][:] = 0  # ledger_must_not_be_zero on valid lanes
-        return (op2, opn2, ts2, bad, codes, prep, tr)
+        return (op2, opn2, ts2, bad, codes, prep, tr, lat)
 
     def _apply_install(self, raw: bytes, dev_ring):
         """Handle an _INSTALL control item ON the apply thread: re-seed
@@ -766,6 +786,7 @@ class DualLedger:
         codes: np.ndarray,
         prepare_checksum: int = 0,
         trace: int = 0,
+        lat_ns: int = 0,
     ) -> None:
         """Enqueue one COMMITTED op for the device applier (follower
         mode): called by the replica at commit finalize, in op order,
@@ -775,13 +796,18 @@ class DualLedger:
         throttling via apply_lag_excess() engages first. `trace` is the
         op's cluster-causal trace id (vsr/header.py): the apply loop tags
         its shadow.upload span with the run's first id, so the device
-        hop joins the op's Perfetto flow."""
+        hop joins the op's Perfetto flow. `lat_ns` is the latency
+        anatomy's enqueue stamp for SAMPLED ops (perf_counter_ns on the
+        event loop): the apply loop observes enqueue->device-dispatch
+        into latency.device_apply_lag_us — the dual mode's parallel
+        lane, never part of the reply's critical-path legs."""
         assert self.follower
         self._enqueued_op = op
         self._enq_ops += 1
         self._put_seq += 1
         self._q.put(
-            (op, operation, timestamp, arr, codes, prepare_checksum, trace)
+            (op, operation, timestamp, arr, codes, prepare_checksum,
+             trace, lat_ns)
         )
 
     def apply_lag_ops(self) -> int:
@@ -820,7 +846,7 @@ class DualLedger:
         # the queue bounds host-memory growth; a full queue briefly
         # backpressures the event loop rather than dropping shadow batches
         # (a dropped batch would be an unverifiable run, not a fast one)
-        self._q.put((None, operation, timestamp, arr, None, 0, 0))
+        self._q.put((None, operation, timestamp, arr, None, 0, 0, 0))
 
     def _fold_native(self, pending) -> None:
         """Chain the native codes into the host-side digest when the engine

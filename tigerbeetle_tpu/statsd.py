@@ -87,12 +87,17 @@ class StatsDEmitter:
 
     Counters emit DELTAS since the previous flush (statsd `|c` semantics)
     and are skipped entirely when unchanged; gauges always emit; histogram
-    snapshots emit p50/p95/p99/max as gauges under `<name>.<stat>`."""
+    snapshots emit p50/p95/p99/max as gauges under `<name>.<stat>` plus
+    the observation-count delta as `<name>.count|c` — so a downstream
+    aggregator can compute observation RATES, and a histogram that saw no
+    new observations since the last flush costs no datagram bytes at all
+    (an idle server used to re-emit every percentile every second)."""
 
     def __init__(self, statsd: StatsD, metrics):
         self.statsd = statsd
         self.metrics = metrics
         self._last: dict[str, float] = {}
+        self._last_hist: dict[str, int] = {}
 
     def _lines(self) -> list[str]:
         snap = self.metrics.snapshot()
@@ -106,8 +111,12 @@ class StatsDEmitter:
         for name, value in snap["gauges"].items():
             lines.append(f"{prefix}.{name}:{value}|g")
         for name, h in snap["histograms"].items():
-            if not h.get("count"):
-                continue
+            count = h.get("count", 0)
+            delta = count - self._last_hist.get(name, 0)
+            if not delta:
+                continue  # nothing observed since the last flush
+            self._last_hist[name] = count
+            lines.append(f"{prefix}.{name}.count:{delta}|c")
             for stat in ("p50", "p95", "p99", "max"):
                 lines.append(f"{prefix}.{name}.{stat}:{h[stat]}|g")
         return lines

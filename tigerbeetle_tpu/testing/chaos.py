@@ -464,6 +464,7 @@ def run_chaos(
     settle_s: float = 1.0,
     ingress: bool = False,
     tmpdir: str | None = None,
+    strict_stream: bool = True,
     log=None,
 ) -> dict:
     """The live chaos run. `faults` is an ordered tuple of CHAOS_ACTIONS
@@ -775,16 +776,35 @@ def run_chaos(
             }
 
         cdc = _parse_cdc_stream(cdc_path)
-        assert cdc["dup_ids"] == 0, f"duplicated transfers in CDC: {cdc}"
-        assert cdc["transfers_bad"] == 0, (
-            f"non-ok transfer results in CDC (double execution?): {cdc}"
-        )
-        assert cdc["unique_ids"] == total, (
-            f"cdc stream drift: {cdc['unique_ids']} unique transfers "
-            f"vs {total} acked"
-        )
-        log(f"cdc stream verified: {cdc['unique_ids']} transfers "
-            f"({cdc['redelivered_records']} redelivered records deduped)")
+        cdc_error = None
+        try:
+            assert cdc["dup_ids"] == 0, (
+                f"duplicated transfers in CDC: {cdc}"
+            )
+            assert cdc["transfers_bad"] == 0, (
+                f"non-ok transfer results in CDC (double execution?): {cdc}"
+            )
+            assert cdc["unique_ids"] == total, (
+                f"cdc stream drift: {cdc['unique_ids']} unique transfers "
+                f"vs {total} acked"
+            )
+            log(f"cdc stream verified: {cdc['unique_ids']} transfers "
+                f"({cdc['redelivered_records']} redelivered records deduped)")
+        except AssertionError as e:
+            # strict mode (the chaos CLI + tests): a stream-verification
+            # failure IS the run's result — raise. The bench failover
+            # segment runs strict_stream=False: the wire-conservation
+            # check above already proved zero lost/duplicated LEDGER
+            # effects, so the measured recovery/tps numbers are valid
+            # even when the CDC stream's replay artifacts fail the
+            # exactly-once audit — the report then carries BOTH the
+            # measurement and the named verification failure instead of
+            # nulling the artifact (the r06 lesson).
+            if strict_stream:
+                raise
+            cdc_error = str(e)[:500]
+            log(f"cdc stream verification FAILED (reported, not fatal): "
+                f"{cdc_error[:200]}")
 
         if backend in ("dual", "native+device"):
             bad = {
@@ -832,6 +852,8 @@ def run_chaos(
                 else None
             ),
             "conservation_ok": True,
+            "cdc_ok": cdc_error is None,
+            "verification_error": cdc_error,
             "cdc": cdc,
             "parity": parity,
             "client": {

@@ -414,6 +414,7 @@ class Simulator:
         client_deadline_ticks: int = 0,
         client_tick_skew: bool = False,
         primary_crash_probability: float = 0.0,
+        latency_sample_every: int = 0,
     ):
         from tigerbeetle_tpu.constants import TEST_PROCESS
 
@@ -450,6 +451,12 @@ class Simulator:
             from tigerbeetle_tpu.testing.hash_log import HashLog
 
             self.hash_log = HashLog(hash_log[0], path=hash_log[1])
+        # Latency-anatomy sampling override (tigerbeetle_tpu/latency.py):
+        # 0 keeps the replica default. Stamps ride the DeterministicTime
+        # seam (virtual ticks), so forcing sample_every=1 must leave the
+        # committed history byte-identical AND fold identical latency
+        # histograms across runs of one seed (tests/test_latency.py).
+        self.latency_sample_every = latency_sample_every
         self.seed = seed
         self.rng = random.Random(seed)
         self.ticks_budget = ticks
@@ -630,6 +637,8 @@ class Simulator:
             standby_count=self.standby_count,
             tracer=self.tracers[i] if self.tracers is not None else None,
         )
+        if self.latency_sample_every:
+            r.latency.sample_every = self.latency_sample_every
         hist = self.histories[i]
 
         def hook(header: Header, body: bytes, _h=hist) -> None:

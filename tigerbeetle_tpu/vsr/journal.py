@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
+from time import perf_counter_ns
 
 from tigerbeetle_tpu.constants import ConfigCluster
 from tigerbeetle_tpu.io.storage import SECTOR_SIZE, Storage, Zone
@@ -140,7 +141,12 @@ class Journal:
         # worker as a plain int for its span tag.
         tid = header.trace() if self.tracer.enabled else 0
         fut = self._executor.submit(
-            self._write_task, slot, sector, hb, body, tid
+            # submit stamp for the WAL parallel lane (latency.py): the
+            # reply only waits on the RESIDUAL of this write at finalize,
+            # so its full submit->durable time is invisible to the
+            # critical-path legs — latency.wal_lane_us carries it
+            self._write_task, slot, sector, hb, body, tid,
+            perf_counter_ns(),
         )
         self._pending_writes.add(fut)
         fut.add_done_callback(self._pending_writes.discard)
@@ -177,7 +183,7 @@ class Journal:
             fut.result()
 
     def _write_task(self, slot: int, sector: int, hb: bytes,
-                    body: bytes, tid: int = 0) -> None:
+                    body: bytes, tid: int = 0, t_submit: int = 0) -> None:
         # prepare FIRST, then the redundant header (same ordering contract
         # as the sync path). Concurrent slots may share a header sector:
         # a slot's header enters the DURABLE mirror only here — after its
@@ -198,6 +204,12 @@ class Journal:
                 self._headers_durable[off : off + HEADER_SIZE] = hb
                 self._write_header_sector(sector)
         self.metrics.counter("journal.writes").add()
+        if t_submit:
+            # WAL lane: event-loop submit -> durable (queue wait + the
+            # 1 MiB O_DSYNC write), observed on the writer thread
+            self.metrics.histogram("latency.wal_lane_us").observe(
+                (perf_counter_ns() - t_submit) / 1000.0
+            )
 
     def invalidate_above(self, op_max: int) -> None:
         """Destroy journal evidence for every op above `op_max` — BOTH the

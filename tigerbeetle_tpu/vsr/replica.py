@@ -45,6 +45,15 @@ from tigerbeetle_tpu.constants import ConfigCluster, ConfigProcess
 from tigerbeetle_tpu.io.network import Network
 from tigerbeetle_tpu.io.storage import Storage
 from tigerbeetle_tpu.io.time import Time
+from tigerbeetle_tpu.latency import (
+    LEG_DISPATCH,
+    LEG_FINALIZE,
+    LEG_FUSE,
+    LEG_QUORUM,
+    LEG_WAIT,
+    LEG_WAL,
+    LatencyAnatomy,
+)
 from tigerbeetle_tpu.lsm.grid import GridBlockCorrupt
 from tigerbeetle_tpu.metrics import Metrics
 from tigerbeetle_tpu.models.ledger import DeviceLedger
@@ -116,6 +125,18 @@ class Replica:
         # tracer is the no-op `none` backend.
         self.metrics = metrics if metrics is not None else Metrics()
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # Per-request critical-path attribution (tigerbeetle_tpu/
+        # latency.py): sampled requests are stamped at every pipeline
+        # leg and fold into the latency.* histograms at reply egress.
+        # The clock is the TIME SEAM's monotonic — simulator replicas
+        # stamp with virtual ticks, so seeded runs stay byte-identical
+        # with stamping on (tests/test_latency.py pins it).
+        self.latency = LatencyAnatomy(
+            metrics=self.metrics, clock=time.monotonic
+        )
+        # optional metrics.FlightRecorder (the server loop installs and
+        # drives it ~1/s); _on_request_stats ships its history when set
+        self.flight_recorder = None
         self.replica = replica_index
         self.replica_count = replica_count
         # Standbys (reference: src/vsr/replica.zig:163-175): replicas with
@@ -878,6 +899,13 @@ class Replica:
         if len(self.pipeline) + lag_excess >= cap:
             return
 
+        # Latency anatomy: the request survived dedup/backpressure and
+        # will become an op — open the sampled record (keyed by the
+        # cluster-causal trace id; the id derivation is paid only for
+        # sampled requests). ingress_admission closes here: gateway
+        # arrival (or now) -> admission+dedup done.
+        lat = self.latency
+        lt = lat.open(header.trace()) if lat.want() else 0
         op = self.op + 1
         assert op not in self.pipeline
         self._maybe_checkpoint(op)
@@ -926,10 +954,18 @@ class Replica:
         else:
             self.journal.write_prepare(prepare, body)
             wal = None
+        if lt:
+            # sync path: the completed WAL write; async path: the submit
+            # (the durable wait lands in commit_finalize; the write's
+            # own submit->durable time is the latency.wal_lane_us lane)
+            lat.stamp(lt, LEG_WAL)
+            if self.quorum_replication == 1:
+                # the self-vote below IS the quorum: close the leg now
+                lat.stamp(lt, LEG_QUORUM)
         self.op = op
         self.parent_checksum = prepare.checksum
         self.pipeline[op] = {"header": prepare, "body": body,
-                             "oks": {self.replica}, "wal": wal,
+                             "oks": {self.replica}, "wal": wal, "lt": lt,
                              # quorum-wait accounting: broadcast -> quorum
                              "t": perf_counter_ns(),
                              # ingress anchor of the op's causal trace:
@@ -1092,8 +1128,24 @@ class Replica:
             "inflight": len(self._inflight),
             "sessions": len(self.client_table),
             "metrics": self.metrics.snapshot(),
+            # per-request breakdowns of the slowest sampled requests
+            # (latency.py top-K ring) — `inspect live` renders them
+            "latency_slowest": self.latency.slowest(limit=16),
         }
+        if self.flight_recorder is not None:
+            # the time-series ring: `inspect live --watch` renders the
+            # per-interval deltas/rates as they accumulate
+            snap["history"] = self.flight_recorder.history()
         body = _json.dumps(snap, sort_keys=True).encode()
+        if HEADER_SIZE + len(body) > self.cluster.message_size_max:
+            # shed detail in layers, never validity: the full history is
+            # the biggest payload — try the newest slice, then drop it
+            if "history" in snap:
+                snap["history"] = snap["history"][-30:]
+                body = _json.dumps(snap, sort_keys=True).encode()
+            if HEADER_SIZE + len(body) > self.cluster.message_size_max:
+                snap.pop("history", None)
+                body = _json.dumps(snap, sort_keys=True).encode()
         if HEADER_SIZE + len(body) > self.cluster.message_size_max:
             # a registry too large for one frame loses its detail, never
             # its validity: the consensus state is the part that must land
@@ -1571,7 +1623,21 @@ class Replica:
         entry = self.pipeline.get(header.op)
         if entry is None or entry["header"].checksum != header.context:
             return
+        before = len(entry["oks"])
         entry["oks"].add(header.replica)
+        if (
+            before < self.quorum_replication
+            and len(entry["oks"]) == self.quorum_replication
+        ):
+            # quorum_wait leg closes at the ack that COMPLETES the
+            # quorum — transition-gated, because a duplicate re-ack
+            # (retransmitted prepare) leaves len(oks) AT quorum and a
+            # re-stamp would fold later legs' time into quorum_wait
+            # (the _note_quorum accounting below fires later, after any
+            # fuse hold — a different boundary)
+            lt = entry.get("lt")
+            if lt:
+                self.latency.stamp(lt, LEG_QUORUM)
         self._maybe_commit_pipeline()
 
     # Max prepares fused into one group commit (the ledger pads smaller
@@ -1608,6 +1674,9 @@ class Replica:
             tok = entry.pop("qtok", 0)
             if tok:
                 self.tracer.stop(tok)
+            # abandoned prepares never reach egress: drop their open
+            # latency records instead of leaking them to eviction
+            self.latency.discard(entry.pop("lt", 0) or None)
 
     def _note_quorum(self, entry: dict) -> None:
         """Close a pipeline entry's quorum-wait accounting (histogram +
@@ -1637,14 +1706,20 @@ class Replica:
                     # overlapped: dispatch now, drain/reply on flush — the
                     # next request's journal write + broadcast run while
                     # the device executes this batch
-                    d = self._commit_dispatch(header, body)
+                    d = self._commit_dispatch(header, body,
+                                              lt=entry.get("lt", 0))
                     d["wal"] = entry.get("wal")
                     self._inflight.append(d)
                     self.group_stats.add("solo_ops")
                     self.flush_commits(keep=self.commit_window, only_ready=True)
                 else:
-                    reply_wire = self._commit_prepare(header, body)
+                    lt = entry.get("lt", 0)
+                    reply_wire = self._commit_prepare(header, body, lt=lt)
                     if reply_wire is not None:
+                        if lt:
+                            self.latency.egress(
+                                lt, header.client, header.context
+                            )
                         self.network.send(
                             self.replica, header.client, reply_wire
                         )
@@ -1697,7 +1772,8 @@ class Replica:
         for e, handle in zip(run, handles):
             h = e["header"]
             self._note_quorum(e)
-            d = self._commit_dispatch(h, e["body"], handle=handle)
+            d = self._commit_dispatch(h, e["body"], handle=handle,
+                                      lt=e.get("lt", 0))
             d["wal"] = e.get("wal")
             self._inflight.append(d)
             self.commit_min = self.commit_max = h.op
@@ -1794,21 +1870,32 @@ class Replica:
                 if got2 is not None:
                     self._spill_prefetch_body(got2[0], got2[1])
 
-    def _commit_prepare(self, header: Header, body: bytes) -> bytes | None:
+    def _commit_prepare(self, header: Header, body: bytes,
+                        lt: int = 0) -> bytes | None:
         """Execute one prepare against the replicated state (identical on
         every replica — determinism is the consensus invariant). EVERY
         replica constructs and stores the reply in its client table
         (reference: src/vsr/client_replies.zig — replies are replicated so
         a post-view-change primary can answer duplicate requests); only the
         primary actually sends it. Returns the reply wire bytes."""
-        return self._commit_finalize(self._commit_dispatch(header, body))
+        return self._commit_finalize(
+            self._commit_dispatch(header, body, lt=lt)
+        )
 
     def _commit_dispatch(self, header: Header, body: bytes,
-                         handle=None) -> dict:
+                         handle=None, lt: int = 0) -> dict:
+        if lt:
+            # fuse_hold leg: quorum reached -> dispatch entry (the
+            # group-fuse hold + the end-of-pump deferral)
+            self.latency.stamp(lt, LEG_FUSE)
         with self.tracer.span("replica.commit_dispatch", op=header.op,
                               trace=self._tid(header)), \
                 self._h_dispatch.time():
-            return self._commit_dispatch_inner(header, body, handle)
+            d = self._commit_dispatch_inner(header, body, handle)
+        d["lt"] = lt
+        if lt:
+            self.latency.stamp(lt, LEG_DISPATCH)
+        return d
 
     def _commit_dispatch_inner(self, header: Header, body: bytes,
                                handle=None) -> dict:
@@ -1900,11 +1987,19 @@ class Replica:
         }
 
     def _commit_finalize(self, entry: dict) -> bytes | None:
+        lt = entry.get("lt", 0)
+        if lt:
+            # commit_wait leg: dispatch exit -> finalize entry (async
+            # commit window: the in-flight queue + device compute)
+            self.latency.stamp(lt, LEG_WAIT)
         with self.tracer.span("replica.commit_finalize",
                               op=entry["header"].op,
                               trace=self._tid(entry["header"])), \
                 self._h_finalize.time():
-            return self._commit_finalize_inner(entry)
+            wire = self._commit_finalize_inner(entry)
+        if lt:
+            self.latency.stamp(lt, LEG_FINALIZE)
+        return wire
 
     def _commit_finalize_inner(self, entry: dict) -> bytes | None:
         """Stage 2: materialize the results (drains the device batch),
@@ -1972,6 +2067,11 @@ class Replica:
                 entry["handle"][1].codes,
                 prepare_checksum=header.checksum,
                 trace=self._tid(header),
+                # device-apply lag is a PARALLEL lane of the anatomy
+                # (the reply does not wait for it): sampled ops carry
+                # their enqueue stamp so the apply loop can observe
+                # enqueue->upload into latency.device_apply_lag_us
+                lat_ns=perf_counter_ns() if entry.get("lt") else 0,
             )
         self.cdc_commit_min = header.op
         wire = reply.to_bytes() + reply_body
@@ -2037,6 +2137,10 @@ class Replica:
                 entry = self._inflight.popleft()
                 wire = self._commit_finalize(entry)
                 if wire is not None and entry["to_client"]:
+                    lt = entry.get("lt", 0)
+                    if lt:
+                        h = entry["header"]
+                        self.latency.egress(lt, h.client, h.context)
                     self.network.send(
                         self.replica, entry["header"].client, wire
                     )
@@ -2056,6 +2160,10 @@ class Replica:
             entry = self._inflight.popleft()
             wire = self._commit_finalize(entry)
             if wire is not None and entry["to_client"]:
+                lt = entry.get("lt", 0)
+                if lt:
+                    h = entry["header"]
+                    self.latency.egress(lt, h.client, h.context)
                 self.network.send(self.replica, entry["header"].client, wire)
 
     def pump_commits(self) -> None:
