@@ -63,6 +63,40 @@ from tigerbeetle_tpu.tracer import NULL_TRACER, JsonTracer
 METRICS = Metrics()
 TRACER = NULL_TRACER
 
+def _jax_cache_bytes() -> int:
+    """Size of the repo's persistent XLA compilation cache (.jax_cache),
+    recorded at driver start and end so the summary carries compile-cache
+    provenance — growth here IS the recompiles the sentinel counted."""
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    total = 0
+    for root, _dirs, files in os.walk(cache):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+_JAX_CACHE_BYTES_START = _jax_cache_bytes()
+
+
+def _sentinel_summary() -> dict | None:
+    """Compile-sentinel totals for THIS driver process (the in-process
+    device phases; subprocess servers report theirs via SIGQUIT/stats).
+    None when the device stack never got imported (host-only runs)."""
+    mod = sys.modules.get("tigerbeetle_tpu.models.ledger")
+    if mod is None:
+        return None
+    snap = mod.COMPILE_SENTINEL.snapshot()
+    return {
+        "total": snap["total"],
+        "post_warmup": snap["post_warmup"],
+        "per_fn": snap["per_fn"],
+    }
+
+
 BASELINE_TPS = 10_000_000.0  # BASELINE.json north-star target
 N_ACCOUNTS = 10_000
 BATCH = 8190  # (1 MiB - 128 B) / 128 B, reference: src/constants.zig:167-168
@@ -1440,12 +1474,29 @@ def main() -> None:
                 "frontier_steps": [
                     [s.get("offered_tps"), s.get("achieved_tps"),
                      s.get("p50_ms"), s.get("p99_ms"), s.get("shed_rate"),
-                     s.get("dominant_leg")]
+                     s.get("dominant_leg"),
+                     s.get("dominant_device_subleg")]
                     for s in frontier.get("steps", [])
                 ],
                 "frontier_accounted_ratio": (
                     frontier.get("breakdown") or {}
                 ).get("accounted_ratio"),
+                # device anatomy: commit_wait decomposed on the applier
+                # thread — the slowest sampled apply item's sub-legs must
+                # account for its span exactly (ratio 1.0 at device
+                # granularity), and the knee names the sub-leg to attack
+                "frontier_device_accounted_ratio": (
+                    frontier.get("device_breakdown") or {}
+                ).get("accounted_ratio"),
+                "frontier_device_dominant": (
+                    frontier.get("device_breakdown") or {}
+                ).get("dominant"),
+                # compile-sentinel + .jax_cache provenance: recompiles
+                # observed in THIS driver process and the cache growth it
+                # caused — post-warmup compiles are the pathology signal
+                "compile_sentinel": _sentinel_summary(),
+                "jax_cache_bytes_start": _JAX_CACHE_BYTES_START,
+                "jax_cache_bytes_end": _jax_cache_bytes(),
             }
         )
     )

@@ -13,8 +13,24 @@ Usage:
 """
 
 import json
+import os
 import platform
 import sys
+
+
+def _jax_cache_bytes() -> int:
+    cache = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    )
+    total = 0
+    for root, _dirs, files in os.walk(cache):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
 
 
 def main() -> int:
@@ -63,6 +79,18 @@ def main() -> int:
         "env": env,
         "tail": tail,
         "segments_incomplete": incomplete,
+        # Compile-cache provenance: the run's recompile story. bench.py
+        # records .jax_cache size + its in-process compile-sentinel
+        # totals in the summary; the artifact also stamps the cache size
+        # at assembly time, so cache churn between run and packaging is
+        # itself visible (a poisoned .jax_cache is the known pathology —
+        # see models/ledger.py and the conftest guard).
+        "jax_cache": {
+            "bytes_at_artifact": _jax_cache_bytes(),
+            "bytes_run_start": parsed.get("jax_cache_bytes_start"),
+            "bytes_run_end": parsed.get("jax_cache_bytes_end"),
+            "compile_sentinel": parsed.get("compile_sentinel"),
+        },
         "parsed": parsed,
     }
     with open(out_path, "w") as f:

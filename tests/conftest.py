@@ -17,7 +17,12 @@ import os
 # every tier-1 run starts from the known-good cache state. Runs BEFORE
 # jax import (tigerbeetle_tpu/__init__ points jax at this directory).
 # TB_JAX_CACHE_GUARD=0 disables (e.g. to bisect the cache itself).
-_CACHE_GUARD_MAX_BYTES = 16 * 1024 * 1024
+# TB_JAX_CACHE_GUARD_MB overrides the threshold (default 16 — unchanged;
+# raise it to study an accumulated cache, lower it to force a clear).
+_CACHE_GUARD_MAX_BYTES = int(
+    float(os.environ.get("TB_JAX_CACHE_GUARD_MB", 16)) * 1024 * 1024
+)
+_CACHE_GUARD_TRIPPED = False
 
 if os.environ.get("TB_JAX_CACHE_GUARD", "1") != "0":
     _cache_dir = os.path.join(
@@ -38,6 +43,7 @@ if os.environ.get("TB_JAX_CACHE_GUARD", "1") != "0":
         if _size > _CACHE_GUARD_MAX_BYTES:
             import sys as _sys
 
+            _CACHE_GUARD_TRIPPED = True
             for _p in _entries:
                 try:
                     os.remove(_p)
@@ -92,3 +98,26 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.fspath.basename in NIGHTLY_MODULES:
             item.add_marker(pytest.mark.nightly)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # The guard runs before jax import, so the cost of a clear — every
+    # kernel recompiled from scratch — can only be counted at session
+    # end, via the compile sentinel (models/ledger.py). A tripped guard
+    # followed by a big compile count IS the PR-10 pathology made
+    # visible; a tripped guard with few compiles means the suite slice
+    # barely touched the device stack.
+    if not _CACHE_GUARD_TRIPPED:
+        return
+    import sys as _sys
+
+    _mod = _sys.modules.get("tigerbeetle_tpu.models.ledger")
+    if _mod is None:
+        return
+    _snap = _mod.COMPILE_SENTINEL.snapshot()
+    print(
+        f"\n[conftest] cache guard tripped this session: "
+        f"{_snap['total']} fresh compile(s) observed by the sentinel "
+        f"({', '.join(f'{k}x{v}' for k, v in sorted(_snap['per_fn'].items())) or 'none'})",
+        file=_sys.stderr,
+    )

@@ -1116,7 +1116,11 @@ def run_frontier(
 
     from tigerbeetle_tpu.inspect import inspect_live
     from tigerbeetle_tpu.io.message_bus import TCPMessageBus
-    from tigerbeetle_tpu.latency import dominant_leg, leg_totals
+    from tigerbeetle_tpu.latency import (
+        device_leg_totals,
+        dominant_leg,
+        leg_totals,
+    )
 
     log = log or (lambda *_: None)
     own_tmp = tmpdir is None
@@ -1319,6 +1323,13 @@ def run_frontier(
                 leg_totals(snap0.get("metrics", {})),
                 leg_totals(snap1.get("metrics", {})),
             )
+            # the commit_wait DECOMPOSITION (device anatomy): which
+            # applier sub-leg dominated this step — the "why" behind a
+            # commit_wait-dominated knee
+            dleg, dshare = dominant_leg(
+                device_leg_totals(snap0.get("metrics", {})),
+                device_leg_totals(snap1.get("metrics", {})),
+            )
             pct = (
                 np.percentile(lat_ms, [50, 95, 99])
                 if lat_ms else [float("nan")] * 3
@@ -1338,12 +1349,15 @@ def run_frontier(
                 ),
                 "dominant_leg": leg,
                 "dominant_leg_share": share,
+                "dominant_device_subleg": dleg,
+                "dominant_device_subleg_share": dshare,
                 "failures": failures,
             }
             out_steps.append(step)
             log(f"step {rate}/s: achieved {step['achieved_tps']}/s "
                 f"p50={step['p50_ms']}ms p99={step['p99_ms']}ms "
-                f"shed_rate={step['shed_rate']} dominant={leg}")
+                f"shed_rate={step['shed_rate']} dominant={leg}"
+                + (f" device={dleg}" if dleg else ""))
             assert failures == 0, f"{failures} transfer batches failed"
 
         # decomposition accounting proof: the slowest sampled request's
@@ -1362,6 +1376,24 @@ def run_frontier(
                 "accounted_ratio": (
                     round(legs_sum / rec["e2e_us"], 4)
                     if rec.get("e2e_us") else None
+                ),
+            }
+        # device-granularity accounting proof: the slowest sampled APPLY
+        # item's sub-legs are consecutive and must sum to its span
+        # exactly (accounted_ratio 1.0 — the commit_wait decomposition)
+        device_breakdown = None
+        dev_slowest = final.get("device_slowest") or []
+        if dev_slowest:
+            drec = dev_slowest[0]
+            dsum = sum(drec.get("legs", {}).values())
+            device_breakdown = {
+                "apply_e2e_us": drec.get("e2e_us"),
+                "legs": drec.get("legs"),
+                "dominant": drec.get("dominant"),
+                "sum_legs_us": round(dsum, 3),
+                "accounted_ratio": (
+                    round(dsum / drec["e2e_us"], 4)
+                    if drec.get("e2e_us") else None
                 ),
             }
         achieved = [s["achieved_tps"] for s in out_steps]
@@ -1387,6 +1419,7 @@ def run_frontier(
             "peak_achieved_tps": peak,
             "saturation_offered_tps": knee,
             "breakdown": breakdown,
+            "device_breakdown": device_breakdown,
             "acked_events": acked_total,
         }
         if backend == "dual" and server_stats:

@@ -139,6 +139,14 @@ class StartArgs:
     # histogram percentiles), ring of ~180 entries served through the
     # [stats] wire command (`inspect live --watch`). 0 disables.
     flight_interval_s: float = 1.0
+    # XLA trace bridge (dual/native+device backends): capture a bounded
+    # jax.profiler window on the device-applier thread into this
+    # directory, starting at the applier's first dequeue after serving
+    # begins. scripts/stitch_trace.py --device-trace merges the captured
+    # device timeline into the stitched Perfetto file, clock-aligned to
+    # our spans (the directory also gets device_trace_meta.json).
+    device_trace: str = ""
+    device_trace_s: float = 3.0  # window length (seconds)
 
 
 @dataclasses.dataclass
@@ -531,6 +539,25 @@ def cmd_start(args) -> int:
         f"(op={replica.op}, commit={replica.commit_min})",
         flush=True,
     )
+    if args.backend != "native":
+        # compile sentinel: serving starts here — any XLA compile past
+        # this point is a hot-path event (device.compiles_post_warmup +
+        # the SIGQUIT dump's event log). The dual warm path already
+        # marked warm; this covers device/sharded backends too.
+        from tigerbeetle_tpu.models.ledger import COMPILE_SENTINEL
+
+        COMPILE_SENTINEL.mark_warm()
+    if args.device_trace:
+        if hasattr(replica.ledger, "start_device_trace"):
+            replica.ledger.start_device_trace(
+                args.device_trace, args.device_trace_s
+            )
+        else:
+            print(
+                f"--device-trace ignored: backend {args.backend!r} has "
+                "no device-applier thread (use dual or native+device)",
+                flush=True,
+            )
     profile_path = os.environ.get("TB_PROFILE")
     prof = None
     if profile_path:
@@ -586,6 +613,15 @@ def cmd_start(args) -> int:
             # (latency.py): where THOSE requests' milliseconds went
             "latency_slowest": replica.latency.slowest(limit=8),
         }
+        _lmod = sys.modules.get("tigerbeetle_tpu.models.ledger")
+        if _lmod is not None:
+            # compile-sentinel totals + bounded event log (post-warmup
+            # compiles are the .jax_cache pathology, named)
+            stats["compile_sentinel"] = _lmod.COMPILE_SENTINEL.snapshot()
+        _da = getattr(replica.ledger, "device_anatomy", None)
+        if _da is not None and _da.slowest():
+            # dual mode: slowest sampled apply items, sub-leg breakdowns
+            stats["device_slowest"] = _da.slowest(limit=8)
         if getattr(replica.ledger, "spill", None) is not None:
             stats["spill"] = dict(replica.ledger.spill.stats)
         if hash_log is not None:
@@ -694,6 +730,14 @@ def cmd_start(args) -> int:
             # the flight recorder's last minute of per-interval history
             "latency_slowest": replica.latency.slowest(limit=8),
         }
+        _lmod = sys.modules.get("tigerbeetle_tpu.models.ledger")
+        if _lmod is not None:
+            # a wedged applier's first suspect: a post-warmup compile
+            # stalling the loop — the event log names the signature
+            snap["compile_sentinel"] = _lmod.COMPILE_SENTINEL.snapshot()
+        _da = getattr(replica.ledger, "device_anatomy", None)
+        if _da is not None and _da.slowest():
+            snap["device_slowest"] = _da.slowest(limit=8)
         if flight is not None:
             snap["history"] = flight.history(last=60)
         sys.stderr.write(f"[quit] stats {_json.dumps(snap)}\n")

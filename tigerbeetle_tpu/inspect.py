@@ -635,6 +635,37 @@ def _watch_line(e: dict) -> str:
     lag = gauges.get("shadow.device_lag_ops")
     if lag:
         parts.append(f"apply_lag={lag}")
+    # device columns (dual mode): applier queue depth, h2d throughput,
+    # dispatch rate, compile events (a nonzero here mid-run is the
+    # .jax_cache pathology), windowed device-busy p99, and the
+    # interval's dominant commit_wait sub-leg
+    qd = gauges.get("device.queue_depth")
+    if qd:
+        parts.append(f"dev_q={qd}")
+    h2d = rate("device.h2d_bytes")
+    if h2d:
+        parts.append(f"h2d={h2d / 1e6:.1f}MB/s")
+    disp = rate("device.dispatches")
+    if disp:
+        parts.append(f"disp/s={disp:.0f}")
+    compiles = c.get("device.compiles", 0)
+    if compiles:
+        parts.append(f"compiles={compiles}")
+    busy = h.get("device.device_busy_us")
+    if busy:
+        parts.append(f"dev_busy_p99={busy['p99']:.0f}us")
+    dbest, dbest_total = None, 0.0
+    for name, w in h.items():
+        if name.startswith("device.") and name.endswith("_us") \
+                and name != "device.apply_e2e_us":
+            total = w["count"] * w.get("mean", 0.0)
+            if total > dbest_total:
+                dbest, dbest_total = name, total
+    if dbest:
+        parts.append(
+            f"dev_dominant={dbest[len('device.'):-len('_us')]}"
+            f"({dbest_total / 1000.0:.1f}ms)"
+        )
     return "  ".join(parts)
 
 

@@ -617,6 +617,45 @@ CATALOG = {
     "latency.wal_lane_us": (
         "histogram", "us", "async WAL: submit -> durable on the writer pool"
     ),
+    # device applier anatomy (latency.py DeviceAnatomy, stamped by
+    # models/dual_ledger.py's apply loop; sub-legs are CONSECUTIVE, so a
+    # sampled item's sub-legs sum to its apply_e2e exactly — this is the
+    # decomposition of the replica's commit_wait leg)
+    "device.queue_wait_us": (
+        "histogram", "us", "apply_commit enqueue -> apply-loop dequeue"
+    ),
+    "device.coalesce_hold_us": (
+        "histogram", "us", "dequeue -> item's stretch enters staging (run assembly)"
+    ),
+    "device.h2d_stage_us": (
+        "histogram", "us", "staging entry -> h2d upload issued (group path)"
+    ),
+    "device.dispatch_us": (
+        "histogram", "us", "upload issued -> kernel dispatch call returned"
+    ),
+    "device.device_busy_us": (
+        "histogram", "us", "dispatch -> fold digest fence ready (device compute)"
+    ),
+    "device.finalize_visible_us": (
+        "histogram", "us", "fence ready -> applied counters/parity visible"
+    ),
+    "device.apply_e2e_us": (
+        "histogram", "us", "enqueue -> finalize-visible (the sub-legs sum to this)"
+    ),
+    "device.samples": ("counter", "items", "apply items stamped end to end"),
+    # device applier throughput surfaces (flight-recorder device columns)
+    "device.queue_depth": ("gauge", "items", "apply-queue depth at the last dequeue"),
+    "device.h2d_bytes": ("counter", "bytes", "event bytes staged for device upload"),
+    "device.dispatches": ("counter", "", "device kernel dispatches (group or solo)"),
+    # compile sentinel (models/ledger.py CompileSentinel wrapping every
+    # jit entry point; post-warmup compiles are hot-path events)
+    "device.compiles": ("counter", "", "XLA compiles observed at any jit entry point"),
+    "device.compiles_post_warmup": (
+        "counter", "", "compiles landing AFTER warmup — hot-path recompile events"
+    ),
+    "device.compile_ms": ("histogram", "ms", "wall time of one observed XLA compile"),
+    # XLA trace bridge (--device-trace profiler window on the applier)
+    "device.trace_windows": ("counter", "", "bounded jax.profiler windows captured"),
     # time-series flight recorder (metrics.py FlightRecorder)
     "flight.records": ("counter", "", "flight-recorder snapshots taken"),
     # cluster-causal tracing + introspection (tracer.py, inspect.py)

@@ -58,6 +58,14 @@ import threading
 import numpy as np
 
 from tigerbeetle_tpu.constants import ConfigProcess
+from tigerbeetle_tpu.latency import (
+    DLEG_BUSY,
+    DLEG_COALESCE,
+    DLEG_DISPATCH,
+    DLEG_H2D,
+    NULL_DEVICE_ANATOMY,
+    DeviceAnatomy,
+)
 from tigerbeetle_tpu.metrics import Metrics
 from tigerbeetle_tpu.models.native_ledger import NativeLedger
 from tigerbeetle_tpu.testing.hash_log import HashLogDivergence
@@ -88,7 +96,7 @@ def _fold_group_fn(k: int, n_pad: int):
         import jax
         import jax.numpy as jnp
 
-        from tigerbeetle_tpu.models.ledger import fold_reply_codes
+        from tigerbeetle_tpu.models.ledger import fold_reply_codes, sentinel_jit
 
         def f(chk, flat, ns, active):
             flat2 = flat[: k * n_pad].reshape(k, n_pad)
@@ -102,7 +110,9 @@ def _fold_group_fn(k: int, n_pad: int):
             c2, _ = jax.lax.scan(body, chk, (flat2, ns, active))
             return c2
 
-        fn = _FOLD_GROUP_CACHE[(k, n_pad)] = jax.jit(f)
+        fn = _FOLD_GROUP_CACHE[(k, n_pad)] = sentinel_jit(
+            f"fold_group_{k}x{n_pad}", f
+        )
     return fn
 
 
@@ -121,7 +131,7 @@ def _fold_group_ring_fn(k: int, n_pad: int):
         import jax
         import jax.numpy as jnp
 
-        from tigerbeetle_tpu.models.ledger import fold_reply_codes
+        from tigerbeetle_tpu.models.ledger import fold_reply_codes, sentinel_jit
 
         def f(chk, ring, idxs, flat, ns, active):
             flat2 = flat[: k * n_pad].reshape(k, n_pad)
@@ -134,8 +144,8 @@ def _fold_group_ring_fn(k: int, n_pad: int):
             c2, chain = jax.lax.scan(body, chk, (flat2, ns, active))
             return c2, ring.at[idxs].set(chain)
 
-        fn = _FOLD_RING_CACHE[("group", k, n_pad)] = jax.jit(
-            f, donate_argnums=(1,)
+        fn = _FOLD_RING_CACHE[("group", k, n_pad)] = sentinel_jit(
+            f"fold_group_ring_{k}x{n_pad}", f, donate_argnums=(1,)
         )
     return fn
 
@@ -146,13 +156,15 @@ def _fold_ring_fn():
     if fn is None:
         import jax
 
-        from tigerbeetle_tpu.models.ledger import fold_reply_codes
+        from tigerbeetle_tpu.models.ledger import fold_reply_codes, sentinel_jit
 
         def f(chk, ring, idx, results, n):
             c2 = fold_reply_codes(chk, results, n)
             return c2, ring.at[idx].set(c2)
 
-        fn = _FOLD_RING_CACHE["solo"] = jax.jit(f, donate_argnums=(1,))
+        fn = _FOLD_RING_CACHE["solo"] = sentinel_jit(
+            "fold_ring_solo", f, donate_argnums=(1,)
+        )
     return fn
 
 
@@ -217,8 +229,17 @@ class DualLedger:
             self._h_apply_lag = metrics.histogram(  # vet: handoff
                 "latency.device_apply_lag_us"
             )
+            # device anatomy: opened/stamped/finished on the APPLY thread
+            # only (the enqueue stamp arrives by value in the apply
+            # tuple); rebinding swaps the whole object — a GIL-atomic
+            # reference swap read per run
+            self.device_anatomy = DeviceAnatomy(metrics)  # vet: handoff
+        # applier throughput surfaces (flight-recorder device columns);
+        # written by the apply thread only
+        self._g_qdepth = metrics.gauge("device.queue_depth")  # vet: handoff
+        self._c_dispatch = metrics.counter("device.dispatches")  # vet: handoff
         # the device ledger's own instrumentation (group staging
-        # fence waits) reports into the same store
+        # fence waits + h2d byte counting) reports into the same store
         self.device.instrument(metrics, tracer)
 
     def __init__(
@@ -312,6 +333,9 @@ class DualLedger:
         self.metrics = Metrics()
         self.tracer = NULL_TRACER
         self.shadow_stats = self.metrics.group("shadow", self.SHADOW_KEYS)
+        self.device_anatomy = NULL_DEVICE_ANATOMY
+        self._g_qdepth = self.metrics.gauge("device.queue_depth")
+        self._c_dispatch = self.metrics.counter("device.dispatches")
         if follower:
             self._lag_gauge = self.metrics.gauge("shadow.device_lag_ops")
             self._overlap_gauge = self.metrics.gauge(
@@ -320,6 +344,13 @@ class DualLedger:
             self._h_apply_lag = self.metrics.histogram(
                 "latency.device_apply_lag_us"
             )
+            self.device_anatomy = DeviceAnatomy(self.metrics)
+        # --device-trace: a bounded jax.profiler window started/stopped
+        # by the APPLY thread (so it brackets real apply work); armed by
+        # the event loop — a GIL-atomic flag flip polled once per run
+        self._trace_armed = False  # vet: handoff
+        self._trace_dir = ""  # vet: handoff
+        self._trace_window_s = 3.0  # vet: handoff
         # device cannot follow a snapshot restore without an install path
         # (shadow mode, or a follower whose snapshot exceeds the device
         # geometry). Set on the event loop, polled by the apply loop: a
@@ -467,6 +498,12 @@ class DualLedger:
         # permanently degrade this process's tunnel transport before the
         # server ever serves (the whole reason the dual mode exists)
         jax.block_until_ready(chk)
+        # compiles past this point are hot-path events (rare tiers and
+        # odd pads compile on demand behind the queue — exactly the
+        # stalls the sentinel exists to name)
+        from tigerbeetle_tpu.models.ledger import COMPILE_SENTINEL
+
+        COMPILE_SENTINEL.mark_warm()
 
     # -- the device apply loop --------------------------------------------
 
@@ -518,12 +555,16 @@ class DualLedger:
                 chk_nat = fold_reply_codes_np(chk_nat, codes)
                 self._op_ring[op2 % APPLY_RING] = (op2, prep, chk_nat)
 
+        trace_until = 0.0  # active --device-trace window deadline
         while not stop:
             t_wait = _time.perf_counter()
             run = [self._q.get()]
             self.shadow_stats.add("idle_s", _time.perf_counter() - t_wait)
             if run[0] is _STOP:
                 break
+            if self._trace_armed:
+                self._trace_armed = False
+                trace_until = self._start_trace_window()
             if isinstance(run[0][0], str):  # control item
                 kind = run[0][0]
                 if kind == _INSTALL:
@@ -537,6 +578,19 @@ class DualLedger:
                 with self._apply_cond:
                     self._apply_cond.notify_all()
                 continue
+            # device anatomy: open a record per SAMPLED item (slot 8, the
+            # commit path's enqueue stamp) as it leaves the queue — the
+            # open closes queue_wait at this item's true dequeue time.
+            # Keyed by the cluster trace id when one flows (slot 7), else
+            # the op number — trace-id sampling is its own knob, and a
+            # live server with tracing off must still decompose (open
+            # rejects tid 0). Unsampled items cost one truthiness test.
+            anat = self.device_anatomy
+            toks = [
+                anat.open(run[0][6] or run[0][0], run[0][7])
+                if run[0][7] else 0
+            ]
+            self._g_qdepth.set(self._q.qsize())
             # drain a run of queued create_transfers batches: one fused
             # group dispatch covers up to GROUP_KS[0] of them — per-batch
             # host work (hazard analysis, upload, launch) is the loop's
@@ -560,9 +614,14 @@ class DualLedger:
                     deferred_control = nxt
                     break
                 run.append(nxt)
+                toks.append(
+                    anat.open(nxt[6] or nxt[0], nxt[7]) if nxt[7] else 0
+                )
             if self._test_apply_delay_s:
                 _time.sleep(self._test_apply_delay_s)
             if self._shadow_error is not None or self._restored:
+                for t in toks:
+                    anat.discard(t)
                 self._consumed_seq += len(run) + (
                     1 if deferred_control is not None else 0
                 )
@@ -570,6 +629,7 @@ class DualLedger:
                 with self._apply_cond:
                     self._apply_cond.notify_all()
                 continue  # drain without applying; finalize reports why
+            any_tok = any(toks)
             try:
                 if self._test_corrupt_apply_op is not None:
                     # seeded divergence injection (hash-log check tests):
@@ -591,6 +651,19 @@ class DualLedger:
                         and run[j][1] == Operation.create_transfers
                     ):
                         j += 1
+                    # coalesce_hold closes here for this stretch's sampled
+                    # items: the run is assembled and staging begins (a
+                    # refused fusion's hazard re-probe counts into the
+                    # following dispatch sub-leg)
+                    stretch_toks = ()
+                    if any_tok:
+                        stretch_toks = [
+                            t for t in toks[i:j if j > i else i + 1] if t
+                        ]
+                        if stretch_toks:
+                            t_co = _time.perf_counter_ns()
+                            for t in stretch_toks:
+                                anat.stamp(t, DLEG_COALESCE, t_co)
                     pendings = None
                     if j - i >= 2:
                         t_stage = _time.perf_counter()
@@ -640,6 +713,7 @@ class DualLedger:
                                 jnp.asarray(active),
                             )
                         self._shadow_batches += m
+                        self._c_dispatch.add()
                         stats = self.shadow_stats
                         stats.add("batches", m)
                         stats.add("groups")
@@ -654,6 +728,24 @@ class DualLedger:
                                 stats["overlapped"] / stats["groups"], 4
                             ))
                         prev_flat = g.results
+                        if stretch_toks:
+                            # h2d_stage closes at the ledger's upload-
+                            # issued seam; device_busy is fenced on the
+                            # fold kernel's chain scalar — blocking is
+                            # allowed (no fetch), but it serializes this
+                            # sampled run against the device, so the
+                            # overlap probe reads ready for ~1/16 of
+                            # groups (the sampling tax)
+                            h2d_ns = self.device.last_h2d_done_ns
+                            t_disp = _time.perf_counter_ns()
+                            for t in stretch_toks:
+                                if h2d_ns:
+                                    anat.stamp(t, DLEG_H2D, h2d_ns)
+                                anat.stamp(t, DLEG_DISPATCH, t_disp)
+                            jax.block_until_ready(chk)
+                            t_busy = _time.perf_counter_ns()
+                            for t in stretch_toks:
+                                anat.stamp(t, DLEG_BUSY, t_busy)
                     else:
                         # fusion refused (a batch failed the fast-tier
                         # proof) or too short: run the stretch per-batch —
@@ -670,6 +762,7 @@ class DualLedger:
                                 pending = self.device.execute_async(
                                     opn2, ts2, arr2
                                 )
+                                self.device._c_h2d.add(arr2.nbytes)
                                 if self.follower:
                                     chk, dev_ring = _fold_ring_fn()(
                                         chk, dev_ring,
@@ -689,6 +782,18 @@ class DualLedger:
                             fold_native_run(run[i:end])
                         self.shadow_stats.add(
                             "stage_s", _time.perf_counter() - t_stage)
+                        self._c_dispatch.add(end - i)
+                        if stretch_toks:
+                            # no h2d seam on the per-batch path (the
+                            # upload rides the dispatch): h2d_stage folds
+                            # as uncrossed, dispatch absorbs it
+                            t_disp = _time.perf_counter_ns()
+                            for t in stretch_toks:
+                                anat.stamp(t, DLEG_DISPATCH, t_disp)
+                            jax.block_until_ready(chk)
+                            t_busy = _time.perf_counter_ns()
+                            for t in stretch_toks:
+                                anat.stamp(t, DLEG_BUSY, t_busy)
                         j = end
                     i = j
             except Exception as e:  # divergence surfaces at finalize
@@ -706,6 +811,13 @@ class DualLedger:
                         )
             self._consumed_seq += len(run)
             note_applied(run[-1][0], len(run))
+            if any_tok:
+                # finalize_visible: watermarks/lag gauge updated — the
+                # applied op is observable to the event loop
+                t_fin = _time.perf_counter_ns()
+                for t in toks:
+                    if t:
+                        anat.finish(t, t_fin)
             if deferred_control is not None:
                 if deferred_control[0] == _INSTALL:
                     try:
@@ -717,6 +829,11 @@ class DualLedger:
                 self._consumed_seq += 1
             with self._apply_cond:
                 self._apply_cond.notify_all()
+            if trace_until and _time.monotonic() >= trace_until:
+                trace_until = 0.0
+                self._stop_trace_window()
+        if trace_until:
+            self._stop_trace_window()
         # written once at apply-loop exit; finalize() joins before reading
         self._chk_device_scalar = chk  # vet: handoff
         self._chk_native_thread = chk_nat
@@ -809,6 +926,59 @@ class DualLedger:
             (op, operation, timestamp, arr, codes, prepare_checksum,
              trace, lat_ns)
         )
+
+    # -- XLA trace bridge (--device-trace) ---------------------------------
+
+    def start_device_trace(self, out_dir: str, window_s: float = 3.0) -> None:
+        """Arm a bounded jax.profiler window: the APPLY thread starts the
+        capture at its next dequeue (so the window brackets real apply
+        work, not idle), runs it for ~window_s, and stops it after the
+        run that crosses the deadline. The profile lands under
+        `out_dir/plugins/profile/<ts>/` (gzipped Chrome trace) next to a
+        `device_trace_meta.json` clock anchor — scripts/stitch_trace.py
+        merges it into the stitched Perfetto file with that anchor."""
+        self._trace_dir = out_dir
+        self._trace_window_s = float(window_s)
+        self._trace_armed = True
+
+    def _start_trace_window(self) -> float:
+        """APPLY thread: begin the capture + write the clock anchor.
+        Returns the monotonic deadline (0.0 on failure)."""
+        import json
+        import os
+        import time as _time
+
+        import jax
+
+        try:
+            os.makedirs(self._trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self._trace_dir)
+            anchor_ns = _time.perf_counter_ns()
+            meta = {
+                # perf_counter_ns at profiler start: our spans' clock at
+                # the device timeline's t~0 (alignment is ~ms-accurate —
+                # good enough to line kernels up under their spans)
+                "anchor_perf_ns": anchor_ns,
+                "anchor_unix_s": round(_time.time(), 6),
+                "window_s": self._trace_window_s,
+            }
+            with open(
+                os.path.join(self._trace_dir, "device_trace_meta.json"), "w"
+            ) as f:
+                json.dump(meta, f, indent=1)
+            self.metrics.counter("device.trace_windows").add()
+            return _time.monotonic() + self._trace_window_s
+        except Exception as e:  # profiling must never take the applier down
+            self._trace_dir = f"<failed: {e}>"
+            return 0.0
+
+    def _stop_trace_window(self) -> None:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
 
     def apply_lag_ops(self) -> int:
         """Committed-but-not-yet-device-applied CREATE ops (enqueued
@@ -976,6 +1146,11 @@ class DualLedger:
         if self.follower:
             s["applied_op"] = self._applied_op
             s["lag_ops"] = self.apply_lag_ops()
+            # worst sampled apply items with their sub-leg breakdowns
+            # (the commit_wait decomposition, latency.py DeviceAnatomy)
+            ds = self.device_anatomy.slowest(4)
+            if ds:
+                s["device_slowest"] = ds
         return s
 
     def _hash_ring_check(self) -> dict:

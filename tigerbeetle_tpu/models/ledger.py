@@ -65,6 +65,10 @@ the `fulfill` column alongside the transfer rows (1:1 by construction).
 
 from __future__ import annotations
 
+import threading
+from collections import deque
+from time import perf_counter_ns, time  # vet: observability-only (compile sentinel)
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -100,6 +104,127 @@ I32 = jnp.int32
 # (sharded ledger): linked | post | void | balancing_debit |
 # balancing_credit. Only no-flag and pending-only events are fast-tier-safe.
 _SLOW_FLAGS = 0b111101
+
+
+# ----------------------------------------------------------------------
+# compile sentinel (every jit entry point in this module and
+# dual_ledger.py routes through sentinel_jit)
+# ----------------------------------------------------------------------
+
+class CompileSentinel:
+    """Process-wide XLA compile observer: every jit entry point wraps in
+    a probe that detects executable-cache growth (a compile) and times
+    it. A compile landing AFTER `mark_warm()` is a hot-path event — the
+    long-documented `.jax_cache` sandbox pathology (a poisoned or absent
+    persistent cache recompiling mid-serving) becomes a named counter
+    (`device.compiles_post_warmup`) plus a bounded event log the SIGQUIT
+    dump and flight recorder surface, instead of an inferred abort.
+
+    Counts accumulate process-wide from import time; `instrument()`
+    (called by DeviceLedger/DualLedger.instrument at setup) rebinds onto
+    the replica's shared registry and carries the accumulated totals in,
+    so warm-up compiles that predate the registry still show. Compiles
+    can land on any thread (warm path on main, group steppers on the
+    apply thread), hence the lock.  # vet: guarded-by=_lock
+    """
+
+    _EVENTS_MAX = 64  # bounded event log (SIGQUIT dump section)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.metrics = NULL_METRICS
+        self.warm = False
+        self.total = 0
+        self.post_warmup = 0
+        self.per_name: dict[str, int] = {}
+        self.events: deque = deque(maxlen=self._EVENTS_MAX)
+        self._bind(NULL_METRICS)
+
+    def _bind(self, m) -> None:
+        self._c_total = m.counter("device.compiles")
+        self._c_post = m.counter("device.compiles_post_warmup")
+        self._h_ms = m.histogram("device.compile_ms")
+
+    def instrument(self, metrics) -> None:
+        """Re-bind onto a shared registry (the replica's); process-wide
+        totals carry over because the fresh registry starts at zero and
+        warm-up compiles predate it."""
+        with self._lock:
+            self.metrics = metrics
+            self._bind(metrics)
+            if self.total:
+                self._c_total.add(self.total)
+            if self.post_warmup:
+                self._c_post.add(self.post_warmup)
+
+    def mark_warm(self) -> None:
+        """Everything compiled past this point is a hot-path event
+        (called after kernel warm-up / at serving start)."""
+        with self._lock:
+            self.warm = True
+
+    def note(self, name: str, ms: float) -> None:
+        with self._lock:
+            self.total += 1
+            self.per_name[name] = self.per_name.get(name, 0) + 1
+            self._c_total.add()
+            self._h_ms.observe(ms)
+            post = self.warm
+            if post:
+                self.post_warmup += 1
+                self._c_post.add()
+            self.events.append({
+                "t": round(time(), 3),
+                "fn": name,
+                "ms": round(ms, 3),
+                "post_warmup": post,
+            })
+
+    def snapshot(self) -> dict:
+        """The [stats]/SIGQUIT section: totals + per-signature counts +
+        the bounded event log (newest last)."""
+        with self._lock:
+            return {
+                "total": self.total,
+                "post_warmup": self.post_warmup,
+                "warm": self.warm,
+                "per_fn": dict(self.per_name),
+                "events": list(self.events),
+            }
+
+
+COMPILE_SENTINEL = CompileSentinel()
+
+
+class _SentinelJit:
+    """One jit entry point under the sentinel. The steady-state cost is
+    two executable-cache-size probes and one clock read per dispatch —
+    noise against a kernel launch. A call that grew the cache compiled:
+    its wall duration (trace + lower + compile + first dispatch) is the
+    observed compile time."""
+
+    __slots__ = ("fn", "name")
+
+    def __init__(self, fn, name: str):
+        self.fn = fn
+        self.name = name
+
+    def __call__(self, *args, **kwargs):
+        fn = self.fn
+        try:
+            before = fn._cache_size()
+        except Exception:  # not a PjitFunction (test double) — pass through
+            return fn(*args, **kwargs)
+        t0 = perf_counter_ns()
+        out = fn(*args, **kwargs)
+        if fn._cache_size() > before:
+            COMPILE_SENTINEL.note(self.name, (perf_counter_ns() - t0) / 1e6)
+        return out
+
+
+def sentinel_jit(name: str, fn, **jit_kwargs):
+    """jax.jit + compile sentinel — the only way this repo jits."""
+    return _SentinelJit(jax.jit(fn, **jit_kwargs), name)
 
 # ----------------------------------------------------------------------
 # conflict-wave scheduling (HazardTracker.plan / DeviceLedger._execute_waves)
@@ -577,25 +702,29 @@ class LedgerKernels:
         # kernels would poison dispatch (see ops/hashtable.py note).
         self.a_dump = 1 << self.a_log2
         self.t_dump = 1 << self.t_log2
-        self.commit_transfers = jax.jit(
-            self._commit_transfers, static_argnames=("mode",), donate_argnums=(0,)
+        self.commit_transfers = sentinel_jit(
+            "commit_transfers", self._commit_transfers,
+            static_argnames=("mode",), donate_argnums=(0,),
         )
-        self.commit_accounts = jax.jit(
-            self._commit_accounts, static_argnames=("mode",), donate_argnums=(0,)
+        self.commit_accounts = sentinel_jit(
+            "commit_accounts", self._commit_accounts,
+            static_argnames=("mode",), donate_argnums=(0,),
         )
         # Residue entry for the WAVE executor: the serial scan over a
         # compacted hazard residue with explicit per-event timestamps.
-        self.commit_transfers_residue = jax.jit(
+        self.commit_transfers_residue = sentinel_jit(
+            "commit_transfers_residue",
             lambda state, ev, n: self._serial_transfers_core(
                 state, ev["rows"], ev["ts"], n
             ),
             donate_argnums=(0,),
         )
-        self.merge_results = jax.jit(
-            lambda r_fast, r_res, idx: r_fast.at[idx].set(r_res, mode="drop")
+        self.merge_results = sentinel_jit(
+            "merge_results",
+            lambda r_fast, r_res, idx: r_fast.at[idx].set(r_res, mode="drop"),
         )
-        self.lookup_accounts = jax.jit(self._lookup_accounts)
-        self.lookup_transfers = jax.jit(self._lookup_transfers)
+        self.lookup_accounts = sentinel_jit("lookup_accounts", self._lookup_accounts)
+        self.lookup_transfers = sentinel_jit("lookup_transfers", self._lookup_transfers)
         self._filters: dict = {}  # (table, field) -> jitted filter scan
 
     # ------------------------------------------------------------------
@@ -638,7 +767,7 @@ class LedgerKernels:
             )
             return rows[idx], total
 
-        self._filters[key] = jax.jit(scan)
+        self._filters[key] = sentinel_jit(f"filter_{table}_{field}", scan)
         return self._filters[key]
 
     # ------------------------------------------------------------------
@@ -1925,6 +2054,10 @@ class DeviceLedger(HostLedgerBase):
     def instrument(self, metrics, tracer) -> None:
         self.metrics = metrics
         self.tracer = tracer
+        # the compile sentinel rides the same registry rebind (warm-up
+        # totals carry over; see CompileSentinel.instrument)
+        COMPILE_SENTINEL.instrument(metrics)
+        self._c_h2d = metrics.counter("device.h2d_bytes")
         if getattr(self, "spill", None) is not None:
             self.spill.instrument(metrics, tracer)
 
@@ -1971,6 +2104,14 @@ class DeviceLedger(HostLedgerBase):
         self._acct_limit = (1 << process.account_slots_log2) // 2
         self._xfer_limit = (1 << process.transfer_slots_log2) // 2
         self.hazards = HazardTracker()
+        # device-anatomy h2d seam: try_execute_group_async stamps the
+        # upload-issued boundary here; the dual applier reads it to close
+        # its h2d_stage sub-leg. Written and read on whichever thread
+        # drives dispatch (the apply thread in dual mode), between the
+        # dispatch call and its return — never concurrently.
+        # vet: owner=device-shadow
+        self.last_h2d_done_ns = 0
+        self._c_h2d = self.metrics.counter("device.h2d_bytes")
         # Start each batch's device->host result copy AT DISPATCH so a
         # reply-serving driver (the VSR replica) drains landed buffers
         # instead of paying sync round trips. OPT-IN: on transports where
@@ -2086,7 +2227,7 @@ class DeviceLedger(HostLedgerBase):
                 packed = jnp.concatenate([res, f])
                 return packed, jnp.concatenate([cnt.reshape(1), f])
 
-            fn = self.kernels._summarize_cache = jax.jit(s)
+            fn = self.kernels._summarize_cache = sentinel_jit("summarize", s)
         return fn
 
     def _wave_stepper(self, W: int, n_pad: int, mode: str):
@@ -2116,7 +2257,9 @@ class DeviceLedger(HostLedgerBase):
                 state, rs = jax.lax.scan(body, state, masks)
                 return state, jnp.max(rs, axis=0)
 
-            fn = cache[(W, n_pad, mode)] = jax.jit(step, donate_argnums=(0,))
+            fn = cache[(W, n_pad, mode)] = sentinel_jit(
+                f"wave_stepper_{W}x{n_pad}_{mode}", step, donate_argnums=(0,)
+            )
         return fn
 
     def _execute_waves(self, arr, n, n_pad, nn, ts, timestamp: int, plan):
@@ -2244,7 +2387,9 @@ class DeviceLedger(HostLedgerBase):
                 # the all-success drain ever transfers
                 return state, flat, jnp.concatenate([cnts, fault])
 
-            fn = cache[(k, n_pad)] = jax.jit(step, donate_argnums=(0,))
+            fn = cache[(k, n_pad)] = sentinel_jit(
+                f"group_stepper_{k}x{n_pad}", step, donate_argnums=(0,)
+            )
         return fn
 
     def try_execute_group_async(self, items) -> list[PendingBatch] | None:
@@ -2306,9 +2451,15 @@ class DeviceLedger(HostLedgerBase):
             if used[i]:
                 rows[i, : used[i]] = 0
                 used[i] = 0
+        dev_rows = jax.device_put(rows)
+        # upload-issued boundary for the device anatomy's h2d_stage
+        # sub-leg (device_put returns once the transfer is initiated; on
+        # aliasing backends it is the staging copy itself)
+        self.last_h2d_done_ns = perf_counter_ns()
+        self._c_h2d.add(rows.nbytes)
         try:
             state, flat, summary = self._group_stepper(k, n_pad)(
-                self.state, jax.device_put(rows), jnp.asarray(ns),
+                self.state, dev_rows, jnp.asarray(ns),
                 jnp.asarray(tss),
             )
         except Exception:
@@ -2346,7 +2497,7 @@ class DeviceLedger(HostLedgerBase):
         server calls this once, after its clock stops)."""
         fn = getattr(self, "_fingerprint_cache", None)
         if fn is None:
-            fn = self._fingerprint_cache = jax.jit(state_fingerprint)
+            fn = self._fingerprint_cache = sentinel_jit("fingerprint", state_fingerprint)
         out = fn(self.state)
         return {k: int(np.asarray(v)) for k, v in out.items()}
 
@@ -2420,7 +2571,9 @@ class DeviceLedger(HostLedgerBase):
                 )
                 return out
 
-            fn = cache[table] = jax.jit(f, donate_argnums=(0,))
+            fn = cache[table] = sentinel_jit(
+                f"install_{table}", f, donate_argnums=(0,)
+            )
         return fn
 
     def install_snapshot_rows(

@@ -1132,6 +1132,13 @@ class Replica:
             # (latency.py top-K ring) — `inspect live` renders them
             "latency_slowest": self.latency.slowest(limit=16),
         }
+        da = getattr(self.ledger, "device_anatomy", None)
+        if da is not None:
+            ds = da.slowest(limit=8)
+            if ds:
+                # dual mode: the slowest sampled APPLY items with their
+                # commit_wait sub-leg breakdowns (latency.py DeviceAnatomy)
+                snap["device_slowest"] = ds
         if self.flight_recorder is not None:
             # the time-series ring: `inspect live --watch` renders the
             # per-interval deltas/rates as they accumulate
