@@ -4,9 +4,10 @@ The driver's artifacts (`BENCH_r0*.json`) wrap one repeated `python
 bench.py` invocation as {n, cmd, rc, tail, parsed}. When a round's
 artifact is produced in-session instead (the driver hasn't run since
 r05), this script builds the same shape from a captured run and adds the
-provenance fields an honest off-rig artifact needs: the platform, the
-size-reduction env knobs, and any segment failures — so no number can be
-mistaken for a rig number.
+provenance fields an honest off-rig artifact needs — the platform, the
+size-reduction env knobs, segment failures, the compile-cache story —
+all through the shared provenance module (tigerbeetle_tpu/artifact.py,
+also the PRODDAY emitter's wrapper, so the two artifacts cannot drift).
 
 Usage:
   python scripts/make_bench_artifact.py OUT.json STDOUT STDERR RC 'ENV...'
@@ -14,23 +15,13 @@ Usage:
 
 import json
 import os
-import platform
 import sys
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
-def _jax_cache_bytes() -> int:
-    cache = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        ".jax_cache",
-    )
-    total = 0
-    for root, _dirs, files in os.walk(cache):
-        for f in files:
-            try:
-                total += os.path.getsize(os.path.join(root, f))
-            except OSError:
-                pass
-    return total
+from tigerbeetle_tpu.artifact import wrap_artifact  # noqa: E402
 
 
 def main() -> int:
@@ -60,39 +51,10 @@ def main() -> int:
         incomplete.append("frontier")
     elif len(parsed["frontier_steps"]) < 4:
         incomplete.append("frontier_short_ladder")
-    artifact = {
-        "n": 1,
-        "cmd": f"env {env} python bench.py",
-        "rc": int(rc),
-        # Off-rig provenance: r01-r05 ran on the TPU v5e rig via the
-        # driver; this round ran in-session on the CPU sandbox (1 core,
-        # JAX_PLATFORMS=cpu) with the size knobs recorded in `cmd`/`env`.
-        # Absolute tps is NOT comparable to r05; same-run ratios
-        # (`*_vs_fast_ratio`, spreads, parity booleans) are the quotable
-        # signals. See README "Conflict-wave scheduling".
-        "platform": {
-            "backend": "cpu",
-            "machine": platform.machine(),
-            "python": platform.python_version(),
-            "note": "in-session CPU sandbox run; not rig-comparable",
-        },
-        "env": env,
-        "tail": tail,
-        "segments_incomplete": incomplete,
-        # Compile-cache provenance: the run's recompile story. bench.py
-        # records .jax_cache size + its in-process compile-sentinel
-        # totals in the summary; the artifact also stamps the cache size
-        # at assembly time, so cache churn between run and packaging is
-        # itself visible (a poisoned .jax_cache is the known pathology —
-        # see models/ledger.py and the conftest guard).
-        "jax_cache": {
-            "bytes_at_artifact": _jax_cache_bytes(),
-            "bytes_run_start": parsed.get("jax_cache_bytes_start"),
-            "bytes_run_end": parsed.get("jax_cache_bytes_end"),
-            "compile_sentinel": parsed.get("compile_sentinel"),
-        },
-        "parsed": parsed,
-    }
+    artifact = wrap_artifact(
+        cmd=f"env {env} python bench.py", rc=int(rc), env=env, tail=tail,
+        parsed=parsed, segments_incomplete=incomplete,
+    )
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
         f.write("\n")

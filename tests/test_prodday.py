@@ -1,0 +1,317 @@
+"""Production-day harness (tigerbeetle_tpu/prodday.py): timeline DSL,
+phase-aligned SLO scorer, the shared recovery probe, and the simulator
+twin's same-seed byte-identity.
+
+The expensive live soak (scripts/prodday.py against a real cluster) is
+marked `slow`; tier-1 proves the deterministic core:
+  - the smoke timeline (3 phases, one scripted primary kill) replayed
+    twice at one seed yields byte-identical committed histories AND
+    byte-identical scorecard JSON;
+  - per-phase recorder slicing is exact (hand-built Metrics ring);
+  - an intentionally-blown p99 budget scores FAIL with the dominant
+    critical-path leg named on the row.
+"""
+
+import json
+
+import pytest
+
+from tigerbeetle_tpu.latency import LEGS
+from tigerbeetle_tpu.metrics import FlightRecorder, Metrics
+from tigerbeetle_tpu.prodday import (
+    Event,
+    Phase,
+    RecoveryProbe,
+    Timeline,
+    offered_rate,
+    production_day,
+    run_sim_twin,
+    scale_timeline,
+    score,
+    scorecard_json,
+    slice_history,
+    smoke_timeline,
+)
+
+SEED = 7
+
+
+@pytest.fixture(scope="module")
+def twin():
+    """One smoke-timeline twin run, shared across the module's tests."""
+    return run_sim_twin(smoke_timeline(), seed=SEED)
+
+
+# -- timeline DSL ------------------------------------------------------
+
+
+def test_offered_rate_shapes():
+    ramp = Phase("r", 10.0, ("ramp", 100, 300), sim_ticks=100)
+    assert offered_rate(ramp, 0.0) == 100
+    assert offered_rate(ramp, 0.5) == 200
+    assert offered_rate(ramp, 1.0) == 300
+    steady = Phase("s", 10.0, ("steady", 250), sim_ticks=100)
+    assert offered_rate(steady, 0.1) == offered_rate(steady, 0.9) == 250
+    spike = Phase("f", 10.0, ("spike", 100, 900), sim_ticks=100)
+    assert offered_rate(spike, 0.1) == 100  # before the crowd
+    assert offered_rate(spike, 0.5) == 900  # middle third
+    assert offered_rate(spike, 0.9) == 100  # after
+
+
+def test_timeline_validation():
+    p = Phase("a", 10.0, ("steady", 10), sim_ticks=100)
+    with pytest.raises(ValueError):  # duplicate phase names
+        Timeline("t", (p, p)).validate()
+    with pytest.raises(ValueError):  # event outside the timeline
+        Timeline("t", (p,), (Event(99.0, "kill_primary"),)).validate()
+    with pytest.raises(ValueError):  # unknown event kind
+        Timeline("t", (p,), (Event(1.0, "meteor"),)).validate()
+    with pytest.raises(ValueError):  # malformed load tuple
+        Phase("b", 10.0, ("ramp", 1), sim_ticks=10).validate()
+    assert production_day().duration_s > 0
+    assert smoke_timeline().total_sim_ticks == 1100
+
+
+def test_phase_at_and_event_tick_mapping():
+    tl = smoke_timeline()
+    assert tl.phase_at(0.0)[0].name == "warm"
+    assert tl.phase_at(12.0)[0].name == "storm"
+    assert tl.phase_at(999.0)[0].name == "cool"  # clamps to the tail
+    # the kill at 17s is 7s into the 15s storm phase (starts at 10s,
+    # 500 ticks from tick 300): 300 + int(7/15*500) = 533
+    assert tl.event_tick(Event(17.0, "kill_primary")) == 533
+
+
+def test_scale_timeline_preserves_shape():
+    tl = scale_timeline(production_day(), time=0.5, rate=2.0)
+    base = production_day()
+    assert tl.duration_s == pytest.approx(base.duration_s * 0.5)
+    assert tl.total_sim_ticks == base.total_sim_ticks  # sim untouched
+    assert [p.name for p in tl.phases] == [p.name for p in base.phases]
+    assert [p.slo for p in tl.phases] == [p.slo for p in base.phases]
+    assert tl.phases[1].load[1] == base.phases[1].load[1] * 2.0
+    assert tl.events[0].at_s == pytest.approx(base.events[0].at_s * 0.5)
+
+
+# -- recovery probe ----------------------------------------------------
+
+
+def test_recovery_probe_requires_post_fault_proof():
+    probe = RecoveryProbe()
+    probe.arm(now=10.0, view=3, issue_seq=40)
+    # a reply from the pre-fault view answering a pre-fault request is
+    # TCP tail traffic, not proof of recovery
+    assert probe.observe_reply(10.001, view=3, issue_seq=40) is None
+    assert probe.armed
+    # newer view proves a new primary served
+    ms = probe.observe_reply(10.5, view=4, issue_seq=40)
+    assert ms == pytest.approx(500.0)
+    assert probe.recoveries_ms == [ms]
+    assert not probe.armed
+    # disarmed probe ignores traffic
+    assert probe.observe_reply(11.0, view=9, issue_seq=99) is None
+
+
+def test_recovery_probe_post_fault_issue_resolves():
+    probe = RecoveryProbe()
+    probe.arm(now=1.0, view=2, issue_seq=10)
+    ms = probe.observe_reply(1.25, view=2, issue_seq=11)
+    assert ms == pytest.approx(250.0)
+
+
+def test_recovery_probe_overlapping_faults_measure_independently():
+    """A second fault before the first resolves must not drop the
+    first's measurement (gray stall + reset storm = compound outage:
+    one reply can prove post-fault service for both, each window
+    measured from its OWN arm time)."""
+    probe = RecoveryProbe()
+    probe.arm(now=10.0, view=3, issue_seq=100)   # gray
+    # backlogged acks: pre-gray issues resolve nothing
+    assert probe.observe_reply(12.0, view=3, issue_seq=99) is None
+    probe.arm(now=17.0, view=3, issue_seq=140)   # reset storm
+    assert probe.armed
+    # first post-reset ack proves post-gray service too
+    ms = probe.observe_reply(19.0, view=3, issue_seq=141)
+    assert ms == pytest.approx(2000.0)  # the newest window
+    assert probe.recoveries_ms == [
+        pytest.approx(9000.0), pytest.approx(2000.0)
+    ]
+    assert not probe.armed
+    # an intermediate proof resolves only the arms it covers
+    probe.arm(now=30.0, view=5, issue_seq=200)
+    probe.arm(now=31.0, view=5, issue_seq=260)
+    assert probe.observe_reply(32.0, view=5, issue_seq=230) is not None
+    assert probe.armed  # the seq-260 arm still waits
+    assert probe.observe_reply(33.0, view=6, issue_seq=230) is not None
+    assert not probe.armed
+
+
+# -- per-phase slicing exactness ---------------------------------------
+
+
+def test_slice_history_exact():
+    m = Metrics()
+    rec = FlightRecorder(m, capacity=16)
+    rec.record(1.0)  # pre-mark entry: phase None
+    rec.set_phase("warm", now_s=1.5)
+    m.counter("x").add(3)
+    rec.record(2.0)
+    rec.record(3.0)
+    rec.set_phase("storm", now_s=3.5)
+    rec.record(4.0)
+    slices = slice_history(rec.history())
+    assert sorted(
+        (k, len(v)) for k, v in slices.items()
+        if k is not None
+    ) == [("storm", 1), ("warm", 2)]
+    assert len(slices[None]) == 1
+    assert [e["t"] for e in slices["warm"]] == [2.0, 3.0]
+    assert slices["storm"][0]["t"] == 4.0
+    assert rec.phase_log == [(1.5, "warm"), (3.5, "storm")]
+    # the mark itself is visible as a counter delta in the next entry
+    assert slices["warm"][0]["counters"]["flight.marks"] == 1
+
+
+def test_registry_swap_clamps():
+    """The sim twin re-attaches the recorder across replica restarts:
+    counter deltas and histogram windows must restart cleanly instead of
+    going negative."""
+    m1 = Metrics()
+    rec = FlightRecorder(m1, capacity=8)
+    m1.counter("c").add(100)
+    m1.histogram("h_us").observe(50.0)
+    rec.record(1.0)
+    m2 = Metrics()  # fresh registry (restarted replica)
+    m2.counter("c").add(7)
+    m2.histogram("h_us").observe(10.0)
+    rec.metrics = m2
+    e = rec.record(2.0)
+    assert e["counters"]["c"] == 7  # new registry's value, not -93
+    assert e["histograms"]["h_us"]["count"] == 1
+
+
+# -- the sim twin ------------------------------------------------------
+
+
+def test_twin_same_seed_byte_identical(twin):
+    again = run_sim_twin(smoke_timeline(), seed=SEED)
+    assert twin["history_digest"] == again["history_digest"]
+    assert twin["scorecard_json"] == again["scorecard_json"]
+    assert twin["phase_log"] == again["phase_log"]
+
+
+def test_twin_runs_the_script(twin):
+    assert twin["scripted_kills"] == 1
+    assert twin["stats"]["crashes"] >= 1
+    assert twin["stats"]["committed_ops"] > 0
+    assert [n for _t, n in twin["phase_log"]] == ["warm", "storm", "cool"]
+    # every recorded entry after the first mark carries its phase
+    phases = {e.get("phase") for e in twin["flight_history"]}
+    assert {"warm", "storm", "cool"} <= phases
+
+
+def test_twin_different_seed_diverges(twin):
+    other = run_sim_twin(smoke_timeline(), seed=SEED + 1)
+    assert twin["history_digest"] != other["history_digest"]
+
+
+def test_twin_scorecard_rows_complete(twin):
+    card = twin["scorecard"]
+    assert card["timeline"] == "smoke"
+    by = {(r["phase"], r["slo"]): r for r in card["rows"]}
+    for name in ("warm", "storm", "cool"):
+        row = by[(name, "p99_ms")]
+        assert row["budget"] > 0
+        assert row["measured"] is None or row["measured"] > 0
+    zl = by[("*", "zero_lost")]
+    assert zl["pass"] is True  # run() raising would have failed the test
+    assert json.loads(twin["scorecard_json"]) == card
+
+
+def test_blown_budget_fails_with_dominant_leg(twin):
+    """Score the SAME deterministic run against an absurd p99 budget:
+    the row must FAIL and name the dominant critical-path leg."""
+    blown = smoke_timeline(p99_budget_ms=0.001)
+    card = score(blown, slice_history(twin["flight_history"]),
+                 checks={"ok": True})
+    assert card["pass"] is False
+    failed = [r for r in card["rows"]
+              if r["pass"] is False and r["slo"] == "p99_ms"]
+    assert failed, card
+    for r in failed:
+        assert r["measured"] > r["budget"]
+        assert r["dominant_leg"] in LEGS
+        assert 0.0 < r["dominant_leg_share"] <= 1.0
+    # scoring is pure: same inputs, same bytes
+    assert scorecard_json(card) == scorecard_json(
+        score(blown, slice_history(twin["flight_history"]),
+              checks={"ok": True})
+    )
+
+
+def test_score_no_data_rows_are_visible_not_green():
+    tl = Timeline(
+        "empty",
+        (Phase("only", 5.0, ("steady", 10), sim_ticks=50,
+               slo={"p99_ms": 100.0, "availability": 0.99}),),
+        slo={"recovery_ms": 1000.0},
+    ).validate()
+    card = score(tl, {})
+    assert card["pass"] is True  # nothing FAILED...
+    assert card["no_data"] == 3  # ...but nothing silently passed either
+    assert all(r["pass"] is None for r in card["rows"])
+
+
+def test_score_recovery_slo():
+    tl = Timeline(
+        "r", (Phase("p", 5.0, ("steady", 10), sim_ticks=50),),
+        slo={"recovery_ms": 1000.0},
+    ).validate()
+    ok = score(tl, {}, recoveries_ms=[400.0, 900.0], faults_armed=2)
+    assert ok["rows"][0]["measured"] == 900.0
+    assert ok["rows"][0]["pass"] is True
+    late = score(tl, {}, recoveries_ms=[1500.0], faults_armed=1)
+    assert late["rows"][0]["pass"] is False
+    # an armed fault that never proved post-fault service IS a failure
+    unresolved = score(tl, {}, recoveries_ms=[400.0], faults_armed=2)
+    assert unresolved["rows"][0]["pass"] is False
+
+
+# -- the live soak (10+ minutes; nightly/slow lane) --------------------
+
+
+@pytest.mark.slow
+def test_prodday_live_soak(tmp_path):
+    """The full scripted day against a live --backend dual cluster:
+    ramp, flash crowd, primary kill + disk-fault restart, gray primary,
+    connection-reset storm, slow CDC consumer — ends with conservation,
+    parity and the CDC audit green and a complete scorecard."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts"),
+    )
+    import importlib
+
+    prodday_script = importlib.import_module("prodday")
+
+    tl = scale_timeline(production_day(), time=2.0, rate=0.5)
+    report = prodday_script.run_prodday(
+        tl, n_sessions=24, conns=4, backend="dual", seed=3,
+        tmpdir=str(tmp_path),
+        log=lambda *a: print(*a, file=sys.stderr),
+    )
+    assert report["checks"]["conservation_ok"], report["conservation"]
+    assert report["checks"]["parity_ok"], report["parity"]
+    assert report["checks"]["cdc_dup_free"], report["cdc"]
+    assert report["events"]["kills"] == 1
+    assert report["events"]["restarts"] >= 1
+    assert report["events"]["disk_fault_slots"]
+    assert report["recoveries_ms"]
+    card = report["scorecard"]
+    assert {r["phase"] for r in card["rows"]} >= {
+        p.name for p in tl.phases
+    }

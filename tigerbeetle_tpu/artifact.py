@@ -1,0 +1,85 @@
+"""Shared artifact provenance: the fields every in-session artifact
+(`BENCH_r0N.json`, `PRODDAY_r0N.json`) must carry so no number can be
+mistaken for a rig number and no two emitters can drift.
+
+The driver's artifacts (r01-r05) ran on the TPU v5e rig; everything
+produced in-session runs on the CPU sandbox, so each artifact stamps:
+
+- the platform block (backend, machine, python, an explicit
+  not-rig-comparable note),
+- segment health (`segments_incomplete`: a null in the summary must
+  read as "segment failed", never "measured zero"),
+- the compile-cache story (`.jax_cache` size at run start / run end /
+  artifact assembly, plus the in-process compile-sentinel totals — a
+  poisoned cache is the known sandbox pathology, see models/ledger.py
+  and the tests/conftest.py guard).
+
+`scripts/make_bench_artifact.py` and the prodday emitter
+(`scripts/prodday.py`) both build their wrapper through
+`wrap_artifact()`; only the `parsed` payload and the incomplete-segment
+rules differ per artifact kind.
+"""
+
+from __future__ import annotations
+
+import os
+import platform as _platform
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def jax_cache_bytes(repo: str | None = None) -> int:
+    """Current on-disk size of the persistent compilation cache."""
+    cache = os.path.join(repo or _REPO, ".jax_cache")
+    total = 0
+    for root, _dirs, files in os.walk(cache):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+def platform_block(backend: str = "cpu",
+                   note: str = "in-session CPU sandbox run; "
+                               "not rig-comparable") -> dict:
+    """Off-rig provenance: absolute tps from a sandbox run is NOT
+    comparable to the rig rounds; same-run ratios, spreads, parity
+    booleans and pass/fail verdicts are the quotable signals."""
+    return {
+        "backend": backend,
+        "machine": _platform.machine(),
+        "python": _platform.python_version(),
+        "note": note,
+    }
+
+
+def jax_cache_block(parsed: dict) -> dict:
+    """The run's recompile story: cache size at run start/end (recorded
+    by the run itself) plus at artifact assembly — cache churn between
+    run and packaging is itself visible."""
+    return {
+        "bytes_at_artifact": jax_cache_bytes(),
+        "bytes_run_start": parsed.get("jax_cache_bytes_start"),
+        "bytes_run_end": parsed.get("jax_cache_bytes_end"),
+        "compile_sentinel": parsed.get("compile_sentinel"),
+    }
+
+
+def wrap_artifact(cmd: str, rc: int, env: str, tail: str, parsed: dict,
+                  segments_incomplete: list[str], n: int = 1,
+                  backend: str = "cpu") -> dict:
+    """The common driver-shaped wrapper {n, cmd, rc, platform, env,
+    tail, segments_incomplete, jax_cache, parsed}."""
+    return {
+        "n": n,
+        "cmd": cmd,
+        "rc": int(rc),
+        "platform": platform_block(backend=backend),
+        "env": env,
+        "tail": tail,
+        "segments_incomplete": segments_incomplete,
+        "jax_cache": jax_cache_block(parsed),
+        "parsed": parsed,
+    }

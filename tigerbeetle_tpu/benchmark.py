@@ -1259,6 +1259,17 @@ def run_frontier(
         # the stale replies into its own numbers (value None = stale).
         inflight: dict[int, float | None] = {}  # client_id -> due time
         for rate in steps:
+            # stamp the ladder step as a flight-recorder phase: the
+            # server's per-interval history slices by step exactly the
+            # way a prodday timeline slices by phase (prodday.py
+            # slice_history), so a frontier run's recorder entries
+            # carry which offered rate produced them
+            try:
+                from tigerbeetle_tpu.inspect import send_mark
+
+                send_mark("127.0.0.1", port, f"step:{rate}", timeout=2.0)
+            except (OSError, RuntimeError, ValueError):
+                pass  # observability only: a missed mark never fails a step
             snap0 = inspect_live("127.0.0.1", port)
             interval = batch / rate
             t_start = time.monotonic()

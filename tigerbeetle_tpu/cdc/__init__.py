@@ -34,6 +34,7 @@ from tigerbeetle_tpu.cdc.cursor import FileCursor, MemoryCursor
 from tigerbeetle_tpu.cdc.pump import AofReplaySource, CdcPump
 from tigerbeetle_tpu.cdc.record import encode_batch, gap_record, record_line
 from tigerbeetle_tpu.cdc.sink import (
+    CountThrottleSink,
     JsonlFileSink,
     MemorySink,
     StdoutSink,
@@ -44,6 +45,7 @@ from tigerbeetle_tpu.cdc.sink import (
 __all__ = [
     "AofReplaySource",
     "CdcPump",
+    "CountThrottleSink",
     "FileCursor",
     "JsonlFileSink",
     "MemoryCursor",

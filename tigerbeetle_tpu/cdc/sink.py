@@ -132,3 +132,34 @@ class ThrottleSink:
 
     def close(self) -> None:
         self.inner.close()
+
+
+class CountThrottleSink:
+    """Count-based slow-consumer wrapper: accepts only every
+    `accept_every`-th emission attempt, refusing the rest. The live
+    analog of the simulator's `_FanoutStore(throttle_every=k)` — no
+    clock involved, so the refusal pattern is deterministic in attempt
+    order and the prodday timeline's "slow CDC consumer" event means the
+    same thing in both harnesses. Behind the fan-out hub the laggard
+    pauses only itself; the WAL/AOF reads cover what the live window
+    released past it."""
+
+    def __init__(self, inner, accept_every: int):
+        assert accept_every >= 1
+        self.inner = inner
+        self.accept_every = accept_every
+        self.attempts = 0
+        self.refusals = 0
+
+    def emit_lines(self, lines: list[str]) -> bool:
+        self.attempts += 1
+        if self.attempts % self.accept_every:
+            self.refusals += 1
+            return False
+        return self.inner.emit_lines(lines)
+
+    def flush(self) -> None:
+        self.inner.flush()
+
+    def close(self) -> None:
+        self.inner.close()

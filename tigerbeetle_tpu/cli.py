@@ -68,6 +68,12 @@ class StartArgs:
     # most one op's records per this many microseconds, REFUSING (not
     # sleeping) in between — backpressure without blocking the loop.
     cdc_slow_us: int = 0
+    # Count-throttled slow consumer (the prodday timeline's laggard;
+    # live analog of the simulator's _FanoutStore throttle_every): the
+    # LAST named sink accepts only every K-th emission attempt. Under
+    # --cdc-fanout only that consumer lags (its fanout position falls
+    # behind; ingress.fanout_lag_ops names the gap). 0 disables.
+    cdc_slow_every: int = 0
     # dump a Chrome trace-event JSON (Perfetto-loadable) of the commit
     # pipeline's spans to this path on shutdown (SIGTERM)
     trace: str = ""
@@ -463,6 +469,7 @@ def cmd_start(args) -> int:
     if args.cdc_jsonl or args.cdc_udp:
         from tigerbeetle_tpu.cdc import (
             CdcPump,
+            CountThrottleSink,
             FileCursor,
             JsonlFileSink,
             ThrottleSink,
@@ -478,6 +485,13 @@ def cmd_start(args) -> int:
             named = [
                 (n, ThrottleSink(s, args.cdc_slow_us)) for n, s in named
             ]
+        if args.cdc_slow_every:
+            # one count-throttled laggard: only the LAST named sink —
+            # with --cdc-fanout the healthy consumers keep pace while
+            # this one's position falls behind (the prodday timeline's
+            # slow-consumer event)
+            n_last, s_last = named[-1]
+            named[-1] = (n_last, CountThrottleSink(s_last, args.cdc_slow_every))
         # an explicit --cdc-cursor names the cursor FILE and is used
         # verbatim (a restart must find the pre-existing cursor); the
         # fan-out path derives per-consumer files by suffixing it
@@ -613,6 +627,10 @@ def cmd_start(args) -> int:
             # (latency.py): where THOSE requests' milliseconds went
             "latency_slowest": replica.latency.slowest(limit=8),
         }
+        if flight is not None and flight.phase_log:
+            # the scenario-phase timeline (prodday `mark` markers): when
+            # each phase of the scripted run began, by the recorder clock
+            stats["phases"] = flight.phase_log
         _lmod = sys.modules.get("tigerbeetle_tpu.models.ledger")
         if _lmod is not None:
             # compile-sentinel totals + bounded event log (post-warmup
@@ -740,6 +758,10 @@ def cmd_start(args) -> int:
             snap["device_slowest"] = _da.slowest(limit=8)
         if flight is not None:
             snap["history"] = flight.history(last=60)
+            if flight.phase_log:
+                # which scenario phase each slice of that history ran
+                # under (prodday `mark` markers)
+                snap["phases"] = flight.phase_log
         sys.stderr.write(f"[quit] stats {_json.dumps(snap)}\n")
         sys.stderr.flush()
 

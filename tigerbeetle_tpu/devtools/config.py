@@ -74,6 +74,11 @@ class VetConfig:
     sim_roots: tuple = (
         "tigerbeetle_tpu/testing/simulator.py",
         "scripts/vopr.py",
+        # the prodday harness: the timeline DSL/scorer must stay
+        # clock-free (the sim twin replays timelines byte-identically),
+        # and the live driver's clock reads must be baselined with whys
+        "tigerbeetle_tpu/prodday.py",
+        "scripts/prodday.py",
     )
     clock_seam: frozenset = frozenset({
         # THE seam: RealTime wraps the OS clocks, DeterministicTime the
@@ -98,6 +103,21 @@ class VetConfig:
         "tigerbeetle_tpu/cdc/sink.py":
             "UDP/StatsD/throttle sinks are prod/bench surfaces; the "
             "sim uses in-memory sinks",
+        # live-cluster drivers pulled in by scripts/prodday.py: they
+        # drive real processes on wall clocks by design; the sim twin
+        # reaches the simulator through tigerbeetle_tpu/prodday.py
+        # without touching them
+        "tigerbeetle_tpu/testing/chaos.py":
+            "live chaos harness: subprocess clusters on wall time",
+        "tigerbeetle_tpu/benchmark.py":
+            "live bench driver: wall-clock load generation",
+        "tigerbeetle_tpu/inspect.py":
+            "wire inspection client for live servers",
+        "tigerbeetle_tpu/artifact.py":
+            "artifact provenance (filesystem walks), not sim state",
+        "tigerbeetle_tpu/client_ffi.py":
+            "FFI client binding (session nonces from OS entropy): prod "
+            "client surface, the sim drives vsr/client.py directly",
     })
     # the executor seam itself + the WAL writer pool: the modules that
     # OWN thread construction behind deterministic alternatives

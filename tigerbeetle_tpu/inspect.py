@@ -590,6 +590,50 @@ def inspect_live(host: str, port: int, timeout: float = 5.0) -> dict:
             buf += chunk
 
 
+def send_mark(host: str, port: int, name: str,
+              timeout: float = 5.0) -> dict:
+    """Stamp a scenario-phase marker into a RUNNING replica's flight
+    recorder (vsr/replica.py _on_mark): the prodday driver calls this at
+    every phase boundary so recorder history slices per phase. Same
+    one-shot framing as inspect_live; returns the ack ({"marked": name,
+    "t": <recorder time base>}) once the mark landed."""
+    import socket
+
+    req = Header(command=int(Command.mark), client=INSPECT_CLIENT_ID)
+    body = name.encode()
+    req.set_checksum_body(body)
+    req.set_checksum()
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(req.to_bytes() + body)
+        buf = b""
+        while True:
+            if len(buf) >= HEADER_SIZE:
+                header = Header.from_bytes(buf[:HEADER_SIZE])
+                if not (HEADER_SIZE <= header.size <= (1 << 20)):
+                    raise RuntimeError(
+                        f"{host}:{port} is not speaking the VSR wire "
+                        f"format (frame size {header.size})"
+                    )
+                if len(buf) >= header.size:
+                    frame, buf = buf[: header.size], buf[header.size :]
+                    if header.command == int(Command.stats):
+                        if not header.valid_checksum():
+                            raise RuntimeError(
+                                "mark ack failed its checksum"
+                            )
+                        return json.loads(
+                            frame[HEADER_SIZE : header.size].decode()
+                        )
+                    continue  # other traffic: skip
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                raise RuntimeError(
+                    "server closed the connection without a mark ack"
+                )
+            buf += chunk
+
+
 def _watch_line(e: dict) -> str:
     """One flight-recorder entry as a compact rates line: committed
     ops/s, frames/s, sheds/s, and the interval's windowed p99 for the
@@ -607,6 +651,10 @@ def _watch_line(e: dict) -> str:
         f"ops/s={rate('server.ops_committed'):.0f}",
         f"frames/s={rate('bus.frames'):.0f}",
     ]
+    if e.get("phase"):
+        # scenario phase (prodday `mark` markers): which part of the
+        # scripted timeline this interval belongs to
+        parts.insert(1, f"phase={e['phase']}")
     shed = rate("ingress.shed")
     if shed:
         parts.append(f"sheds/s={shed:.0f}")

@@ -434,6 +434,46 @@ def leg_totals(metrics_snapshot: dict) -> dict[str, dict]:
     return out
 
 
+def windowed_leg_totals(entries: list[dict], legs=LEGS,
+                        prefix: str = "latency") -> dict[str, dict]:
+    """Per-leg {count, total_us} summed over flight-recorder entries'
+    WINDOWED histograms — the per-PHASE analog of leg_totals(): a
+    cumulative snapshot delta needs live before/after probes, but a
+    recorder slice already carries each interval's window, so a phase's
+    leg totals are just the sum of its entries' windows. Shared by the
+    prodday scorecard (live history via [stats], sim-twin recorder
+    directly). Pass legs=DEVICE_LEGS, prefix="device" for the
+    commit_wait sub-leg decomposition."""
+    out: dict[str, dict] = {}
+    for e in entries:
+        hists = e.get("histograms", {})
+        for leg in legs:
+            w = hists.get(f"{prefix}.{leg}_us")
+            if w and w.get("count"):
+                d = out.setdefault(leg, {"count": 0, "total_us": 0.0})
+                d["count"] += w["count"]
+                d["total_us"] += w["count"] * w.get("mean", 0.0)
+    for d in out.values():
+        d["total_us"] = round(d["total_us"], 3)
+    return out
+
+
+def dominant_in_entries(entries: list[dict], legs=LEGS,
+                        prefix: str = "latency") -> tuple[str | None, float]:
+    """(leg, share) with the largest windowed total across a recorder
+    slice — the prodday scorecard's "why did this phase blow its
+    budget" attribution (dominant_leg()'s shape, fed from windows
+    instead of snapshot deltas). Ties break by leg name for
+    deterministic scorecards."""
+    totals = windowed_leg_totals(entries, legs, prefix)
+    if not totals:
+        return None, 0.0
+    grand = sum(d["total_us"] for d in totals.values())
+    leg = max(sorted(totals), key=lambda k: totals[k]["total_us"])
+    share = totals[leg]["total_us"] / grand if grand else 0.0
+    return leg, round(share, 4)
+
+
 def dominant_leg(before: dict, after: dict) -> tuple[str | None, float]:
     """(leg, share) with the largest total-time delta between two
     leg_totals() extracts — the frontier's per-step attribution."""

@@ -297,6 +297,25 @@ class FlightRecorder:
         self._prev_counters: dict[str, float] = {}
         # histogram window state: name -> (count, total, counts[:])
         self._prev_hist: dict[str, tuple] = {}
+        # Scenario phase (the prodday harness's `mark` wire command):
+        # every entry recorded while a phase is set carries it, so the
+        # SLO scorer slices the ring per phase. Only ever written from
+        # the event loop that drives record() (replica._on_mark and the
+        # server loop run on the same thread).
+        # vet: owner=event-loop
+        self.phase: str | None = None
+        self.phase_log: list[tuple[float, str]] = []  # (t, name)
+
+    def set_phase(self, name: str, now_s: float | None = None) -> float:
+        """Stamp a phase transition: subsequent entries carry `name`.
+        With no timestamp the transition is stamped at the last record's
+        time base — within one interval of the truth and clock-free, so
+        the sim twin's recorder stays inside the determinism closure."""
+        t = now_s if now_s is not None else (self._prev_t or 0.0)
+        self.phase = name
+        self.phase_log.append((round(t, 3), name))
+        self.metrics.counter("flight.marks").add()
+        return t
 
     def record(self, now_s: float) -> dict:
         m = self.metrics
@@ -313,6 +332,11 @@ class FlightRecorder:
                 # `+1` in every entry is payload noise, not signal
             v = c.value
             d = v - self._prev_counters.get(name, 0)
+            if d < 0:
+                # the attached registry was swapped for a fresh one (the
+                # prodday sim twin re-attaches across a replica restart):
+                # count the new registry's value as this interval's delta
+                d = v
             if d:
                 self._prev_counters[name] = v
                 c_delta[name] = round(d, 6) if isinstance(d, float) else d
@@ -325,6 +349,15 @@ class FlightRecorder:
             p_count, p_total, p_cs = self._prev_hist.get(
                 name, (0, 0.0, None)
             )
+            if count < p_count or (
+                p_cs is not None
+                and any(a < b for a, b in zip(cs, p_cs))
+            ):
+                # registry swap (see the counter clamp above): total
+                # count or any bucket went BACKWARDS, impossible for a
+                # monotone histogram — the window restarts from zero
+                # against the fresh histogram
+                p_count, p_total, p_cs = 0, 0.0, None
             dc = count - p_count
             if dc > 0:
                 dcs = (
@@ -346,6 +379,8 @@ class FlightRecorder:
             "gauges": {n: g.value for n, g in sorted(gauges)},
             "histograms": h_win,
         }
+        if self.phase is not None:
+            entry["phase"] = self.phase
         if len(self.entries) < self.capacity:
             self.entries.append(entry)
         else:
@@ -658,6 +693,8 @@ CATALOG = {
     "device.trace_windows": ("counter", "", "bounded jax.profiler windows captured"),
     # time-series flight recorder (metrics.py FlightRecorder)
     "flight.records": ("counter", "", "flight-recorder snapshots taken"),
+    "flight.marks": ("counter", "", "phase-marker transitions stamped (prodday `mark`)"),
+    "inspect.marks": ("counter", "", "`mark` wire commands served (vsr/replica.py _on_mark)"),
     # cluster-causal tracing + introspection (tracer.py, inspect.py)
     "trace.sigquit_dumps": ("counter", "", "SIGQUIT hang-diagnosis dumps taken"),
     "inspect.live_requests": ("counter", "", "live [stats] snapshots served over the wire"),

@@ -1,0 +1,641 @@
+"""Production-day harness: scripted scenario timeline, phase-aligned
+SLO scorecard, and the recovery probe both drivers share.
+
+A benchmark measures one regime; a production day strings regimes
+together — diurnal ramp, steady state, a flash crowd onto the hot
+accounts, a primary kill mid-spike, a gray (wedged, not dead) replica, a
+connection-reset storm, a WAL disk fault surfacing on restart, a slow
+CDC consumer — and asks one question per phase: did the cluster hold its
+SLOs *through* the story, not just on average? (The reference's VOPR
+plays the same trick in miniature: a scripted fault swarm plus a
+liveness checker that must see progress after the swarm ends.)
+
+This module is the harness's pure core, and it is deliberately
+clock-free (callers pass timestamps) with seeded randomness only, so it
+sits inside the determinism closure and the simulator twin can replay a
+timeline byte-identically:
+
+- the **timeline DSL**: `Phase` (offered-load curve + per-phase SLO
+  budgets) and `Event` (faults at offsets) compose into a `Timeline`;
+  `offered_rate()` turns a phase's curve into events/s at any instant.
+  Each phase carries BOTH its live shape (duration_s, load curve) and
+  its sim shape (sim_ticks, sim_duty) so one declaration drives the
+  live cluster and the deterministic twin.
+- the **scorer**: `slice_history()` splits flight-recorder entries by
+  the `phase` stamp the `mark` wire command wrote (vsr/replica.py
+  `_on_mark`), and `score()` grades every declared SLO against its
+  slice — measured value, budget, pass/fail, and for any violated
+  phase the dominant critical-path leg (latency.py windowed totals)
+  plus the dominant device sub-leg, so a red row names its bottleneck.
+- the **recovery probe**: armed at fault time, resolved by the first
+  reply that PROVES post-fault service (newer view, or a reply to a
+  request issued after the fault) — `testing/chaos.py` delegates to it,
+  so the bench failover number and the prodday recovery SLO are one
+  code path.
+- the **sim twin**: `run_sim_twin()` maps the same timeline onto the
+  simulator's fault axes (kills -> `kill_primary`, the storm ->
+  `storm_tick`, the disk flip -> `wal_fault_probability`, the slow
+  consumer -> the throttled fan-out store) and records a flight ring on
+  virtual ticks; same seed => byte-identical histories AND scorecards.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+
+from tigerbeetle_tpu.latency import DEVICE_LEGS, LEGS, dominant_in_entries
+
+# Event kinds a timeline may schedule. Live semantics (scripts/
+# prodday.py) vs sim mapping (run_sim_twin):
+#   kill_primary            SIGKILL the current primary        | Simulator.kill_primary()
+#   gray_primary            SIGSTOP (wedged-not-dead) primary  | kill_primary() — a stopped
+#                           for `arg` seconds, then SIGCONT    | process needs a live OS; the
+#                                                              | sim's nearest axis is a crash
+#   reset_conns             RST every client bus, sessions     | connect storm: `arg` new
+#                           reconnect + `arg` new sessions     | sessions at the event tick
+#   disk_fault_on_restart   arm: next restart boots from a     | wal_fault_probability=1.0
+#                           WAL with an injected fault         | from the event tick on
+#   slow_consumer           wrap the last named CDC sink in    | throttled fan-out store
+#                           CountThrottleSink(accept_every=arg)| (cdc_fanout_throttle=arg)
+EVENT_KINDS = (
+    "kill_primary",
+    "gray_primary",
+    "reset_conns",
+    "disk_fault_on_restart",
+    "slow_consumer",
+)
+
+# Load-curve shapes (`Phase.load[0]`): how offered_rate() interpolates
+# across the phase. All rates are events/s (a batch of k transfers is k
+# events, matching benchmark.py's open-loop accounting).
+LOAD_SHAPES = ("ramp", "steady", "spike")
+
+
+@dataclass(frozen=True)
+class Phase:
+    """One chapter of the day: a load curve plus the SLOs it must hold.
+
+    `load` is (shape, *rates): ("ramp", lo, hi) interpolates linearly,
+    ("steady", r) holds r, ("spike", base, peak) holds base with peak
+    through the middle third — the flash crowd. `slo` maps budget keys
+    to bounds: p99_ms (phase p99 latency budget), availability (min
+    acked/offered fraction, typed sheds and timeouts count against),
+    shed_rate (max typed-shed fraction), cdc_lag_ops (max CDC lag gauge
+    observed in the phase). `hot_accounts` >0 points the spike's
+    transfers at a zipfian-hot subset (live driver knob)."""
+
+    name: str
+    duration_s: float
+    load: tuple
+    sim_ticks: int
+    sim_duty: float = 0.5  # SimClient issue probability per idle draw
+    slo: dict = field(default_factory=dict)
+    hot_accounts: int = 0
+
+    def validate(self) -> None:
+        if self.load[0] not in LOAD_SHAPES:
+            raise ValueError(f"phase {self.name}: unknown load shape "
+                             f"{self.load[0]!r} (want {LOAD_SHAPES})")
+        want = {"ramp": 3, "steady": 2, "spike": 3}[self.load[0]]
+        if len(self.load) != want:
+            raise ValueError(f"phase {self.name}: load {self.load!r} "
+                             f"needs {want} elements")
+        if self.duration_s <= 0 or self.sim_ticks <= 0:
+            raise ValueError(f"phase {self.name}: empty duration")
+        if not 0.0 < self.sim_duty <= 1.0:
+            raise ValueError(f"phase {self.name}: sim_duty out of (0,1]")
+
+
+@dataclass(frozen=True)
+class Event:
+    """A scheduled fault: `kind` (EVENT_KINDS) fired `at_s` seconds into
+    the timeline (live) / at the proportional tick (sim). `arg` is the
+    kind-specific dial (gray hold seconds, storm session count, slow
+    consumer accept_every)."""
+
+    at_s: float
+    kind: str
+    arg: int = 0
+
+    def validate(self, total_s: float) -> None:
+        if self.kind not in EVENT_KINDS:
+            raise ValueError(f"unknown event kind {self.kind!r}")
+        if not 0.0 <= self.at_s < total_s:
+            raise ValueError(f"event {self.kind} at {self.at_s}s is "
+                             f"outside the {total_s}s timeline")
+
+
+@dataclass(frozen=True)
+class Timeline:
+    """The whole day: ordered phases, scheduled events, and the
+    timeline-level SLOs that don't belong to one phase — recovery_ms
+    (every armed fault must prove post-fault service within budget),
+    cdc_lag_ops (day-wide lag bound), zero_lost (wire conservation +
+    hash-log parity + CDC dedup must all hold)."""
+
+    name: str
+    phases: tuple
+    events: tuple = ()
+    slo: dict = field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> float:
+        return sum(p.duration_s for p in self.phases)
+
+    @property
+    def total_sim_ticks(self) -> int:
+        return sum(p.sim_ticks for p in self.phases)
+
+    def validate(self) -> "Timeline":
+        if not self.phases:
+            raise ValueError("timeline has no phases")
+        names = [p.name for p in self.phases]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate phase names: {names}")
+        for p in self.phases:
+            p.validate()
+        for e in self.events:
+            e.validate(self.duration_s)
+        return self
+
+    def phase_at(self, t_s: float):
+        """(phase, seconds-into-phase) at timeline offset t_s."""
+        acc = 0.0
+        for p in self.phases:
+            if t_s < acc + p.duration_s:
+                return p, t_s - acc
+            acc += p.duration_s
+        return self.phases[-1], self.phases[-1].duration_s
+
+    def phase_starts_s(self) -> list:
+        """[(start_s, phase), ...] in declaration order."""
+        out, acc = [], 0.0
+        for p in self.phases:
+            out.append((acc, p))
+            acc += p.duration_s
+        return out
+
+    def phase_starts_ticks(self) -> list:
+        """[(start_tick, phase), ...] — the sim twin's boundaries."""
+        out, acc = [], 0
+        for p in self.phases:
+            out.append((acc, p))
+            acc += p.sim_ticks
+        return out
+
+    def event_tick(self, e: Event) -> int:
+        """Map a live offset to a sim tick, proportionally per phase (a
+        kill 30s into a 60s phase lands halfway through its ticks)."""
+        p, into = self.phase_at(e.at_s)
+        start = dict((ph.name, t) for t, ph in self.phase_starts_ticks())
+        return start[p.name] + int(into / p.duration_s * p.sim_ticks)
+
+
+def offered_rate(phase: Phase, frac: float) -> float:
+    """events/s at `frac` in [0,1) through the phase."""
+    shape = phase.load[0]
+    if shape == "steady":
+        return float(phase.load[1])
+    if shape == "ramp":
+        lo, hi = phase.load[1], phase.load[2]
+        return lo + (hi - lo) * frac
+    base, peak = phase.load[1], phase.load[2]  # spike
+    return float(peak if 1 / 3 <= frac < 2 / 3 else base)
+
+
+def scale_timeline(tl: Timeline, time: float = 1.0,
+                   rate: float = 1.0) -> Timeline:
+    """The sandbox dial: shrink/stretch a timeline's wall durations
+    (`time`) and offered rates (`rate`) without touching its SHAPE —
+    phase SLOs, event ordering and the sim mapping stay identical, so a
+    20%-length rehearsal still tells the same story."""
+    from dataclasses import replace
+
+    phases = tuple(
+        replace(
+            p,
+            duration_s=p.duration_s * time,
+            load=(p.load[0],) + tuple(r * rate for r in p.load[1:]),
+        )
+        for p in tl.phases
+    )
+    events = tuple(replace(e, at_s=e.at_s * time) for e in tl.events)
+    return Timeline(tl.name, phases, events, dict(tl.slo)).validate()
+
+
+# -- recovery probe ----------------------------------------------------
+
+
+class RecoveryProbe:
+    """Time-to-first-commit-after-fault, by PROOF of post-fault service.
+
+    Armed with the pre-fault view and issue sequence; resolved by the
+    first reply carrying a view newer than the fault-time view (a new
+    primary served or resent it) or answering a request ISSUED after the
+    fault. A bare "next reply" would under-read the metric: bytes the
+    dead primary wrote to a socket just before the SIGKILL are still
+    delivered by TCP and would resolve the probe in ~1ms.
+
+    Overlapping faults arm INDEPENDENT probes: a second fault landing
+    before the first resolves must not drop the first's measurement
+    (a gray-primary stall followed by a connection-reset storm is one
+    compound outage, but each fault's recovery window is its own — a
+    reply proving post-reset service usually proves post-gray service
+    too and resolves both, each measured from its OWN arm time).
+
+    Clock-free (callers pass `now`); `testing/chaos.py` feeds it
+    wall-clock monotonic seconds, so the bench failover segment and the
+    prodday recovery SLO read the same arithmetic."""
+
+    def __init__(self, histogram=None):
+        self.histogram = histogram  # optional: chaos.recovery_ms
+        self.recoveries_ms: list = []
+        self._pending: list = []  # [(armed_at, view, issue_seq), ...]
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._pending)
+
+    def arm(self, now: float, view: int, issue_seq: int) -> None:
+        self._pending.append((now, view, issue_seq))
+
+    def observe_reply(self, now: float, view: int, issue_seq: int):
+        """Feed one harvested reply; resolves EVERY pending arm this
+        reply proves post-fault service for (in arm order, each from
+        its own arm time). Returns the newest resolved window in ms,
+        else None."""
+        if not self._pending:
+            return None
+        resolved_ms = None
+        keep = []
+        for at, v, s in self._pending:
+            if view > v or issue_seq > s:
+                ms = (now - at) * 1e3
+                self.recoveries_ms.append(ms)
+                if self.histogram is not None:
+                    self.histogram.observe(ms)
+                resolved_ms = ms
+            else:
+                keep.append((at, v, s))
+        self._pending = keep
+        return resolved_ms
+
+
+# -- phase-aligned scoring ---------------------------------------------
+
+
+def slice_history(entries: list) -> dict:
+    """Partition flight-recorder entries by their `phase` stamp, in
+    ring order. Entries recorded before the first mark land under
+    None."""
+    out: dict = {}
+    for e in entries:
+        out.setdefault(e.get("phase"), []).append(e)
+    return out
+
+
+def _slice_p99_ms(entries: list):
+    """Worst per-interval windowed e2e p99 in the slice, in ms — the
+    recorder-derived latency measurement (the live driver overrides it
+    with its own due-time p99 when it has one)."""
+    worst = None
+    for e in entries:
+        h = e.get("histograms", {}).get("latency.e2e_us")
+        if h and h.get("p99") is not None:
+            v = h["p99"] / 1e3
+            worst = v if worst is None or v > worst else worst
+    return round(worst, 3) if worst is not None else None
+
+
+def _slice_cdc_lag(entries: list):
+    """Worst CDC lag gauge in the slice (single-pump `cdc.lag_ops` or
+    the fan-out hub's `ingress.fanout_lag_ops`)."""
+    worst = None
+    for e in entries:
+        g = e.get("gauges", {})
+        for k in ("cdc.lag_ops", "ingress.fanout_lag_ops"):
+            if k in g:
+                v = g[k]
+                worst = v if worst is None or v > worst else worst
+    return worst
+
+
+def _dominant(entries: list) -> dict:
+    """Name the bottleneck for a violated row: the dominant critical-
+    path leg across the slice's windowed histograms, plus the dominant
+    device sub-leg when commit_wait dominates (PR 18's device
+    anatomy)."""
+    leg, share = dominant_in_entries(entries, legs=LEGS, prefix="latency")
+    out: dict = {"dominant_leg": leg, "dominant_leg_share": share}
+    if leg == "commit_wait":
+        sub, sub_share = dominant_in_entries(
+            entries, legs=DEVICE_LEGS, prefix="device"
+        )
+        out["dominant_device_subleg"] = sub
+        out["dominant_device_subleg_share"] = sub_share
+    return out
+
+
+def _row(phase, slo: str, budget, measured, ok, entries: list) -> dict:
+    row = {
+        "phase": phase,
+        "slo": slo,
+        "budget": budget,
+        "measured": measured,
+        "pass": ok,
+    }
+    if ok is False and entries:
+        row.update(_dominant(entries))
+    return row
+
+
+def score(timeline: Timeline, slices: dict, *, measures: dict = None,
+          recoveries_ms: list = None, faults_armed: int = 0,
+          checks: dict = None) -> dict:
+    """Grade every declared SLO. `slices` is slice_history() output;
+    `measures` optionally maps phase name -> {availability, shed_rate,
+    p99_ms, offered, acked, shed, timeouts} from the driver's own
+    bookkeeping (the recorder can't see offered load that was never
+    admitted). Rows come out in declaration order with SLO keys sorted,
+    so two runs that measure identically serialize identically.
+
+    A row with measured=None scores pass=None ("no data"): visible,
+    never silently green. The overall verdict fails only on an explicit
+    False row."""
+
+    measures = measures or {}
+    rows = []
+    for p in timeline.phases:
+        entries = slices.get(p.name, [])
+        m = measures.get(p.name, {})
+        for key in sorted(p.slo):
+            budget = p.slo[key]
+            if key == "p99_ms":
+                v = m.get("p99_ms")
+                if v is None:
+                    v = _slice_p99_ms(entries)
+                ok = None if v is None else v <= budget
+            elif key == "availability":
+                v = m.get("availability")
+                ok = None if v is None else v >= budget
+            elif key == "shed_rate":
+                v = m.get("shed_rate")
+                ok = None if v is None else v <= budget
+            elif key == "cdc_lag_ops":
+                v = _slice_cdc_lag(entries)
+                if v is None:
+                    v = m.get("cdc_lag_ops")
+                ok = None if v is None else v <= budget
+            else:
+                raise ValueError(f"phase {p.name}: unknown SLO {key!r}")
+            rows.append(_row(p.name, key, budget, v, ok, entries))
+
+    all_entries = [e for p in timeline.phases
+                   for e in slices.get(p.name, [])]
+    for key in sorted(timeline.slo):
+        budget = timeline.slo[key]
+        if key == "recovery_ms":
+            if recoveries_ms is None:
+                v, ok = None, None  # live-only probe (the sim's virtual
+                # clock makes wall recovery time meaningless)
+            elif faults_armed and len(recoveries_ms) < faults_armed:
+                v, ok = None, False  # an armed fault never proved
+                # post-fault service: that IS the violation
+            elif recoveries_ms:
+                v = round(max(recoveries_ms), 3)
+                ok = v <= budget
+            else:
+                v, ok = None, None
+        elif key == "cdc_lag_ops":
+            v = _slice_cdc_lag(all_entries)
+            ok = None if v is None else v <= budget
+        elif key == "zero_lost":
+            v = checks if checks else None
+            ok = None if v is None else all(checks.values())
+        else:
+            raise ValueError(f"timeline: unknown SLO {key!r}")
+        rows.append(_row("*", key, budget, v, ok, all_entries))
+
+    return {
+        "timeline": timeline.name,
+        "rows": rows,
+        "violations": sum(1 for r in rows if r["pass"] is False),
+        "no_data": sum(1 for r in rows if r["pass"] is None),
+        "pass": all(r["pass"] is not False for r in rows),
+    }
+
+
+def scorecard_json(card: dict) -> str:
+    """Canonical serialization — the byte string two same-seed sim-twin
+    runs must reproduce exactly."""
+    return json.dumps(card, sort_keys=True, separators=(",", ":"))
+
+
+# -- deterministic history digest --------------------------------------
+
+
+def history_digest(histories: list) -> str:
+    """sha256 over a stable serialization of every replica's committed
+    (op -> checksum, operation, timestamp, body) history — the byte-
+    identity witness for same-seed twin runs."""
+    h = hashlib.sha256()
+    for i, hist in enumerate(histories):
+        h.update(f"replica {i}:{len(hist)};".encode())
+        for op in sorted(hist):
+            checksum, operation, timestamp, body = hist[op]
+            h.update(f"{op},{checksum},{operation},{timestamp},".encode())
+            h.update(hashlib.sha256(body).digest())
+    return h.hexdigest()
+
+
+# -- the simulator twin ------------------------------------------------
+
+
+def run_sim_twin(timeline: Timeline, seed: int, *, n_clients: int = 2,
+                 record_every: int = 50, replica_count: int = 3,
+                 crash_probability: float = 0.0,
+                 sim_kwargs: dict = None) -> dict:
+    """Replay the timeline in the deterministic simulator: phases set
+    the clients' duty cycle, events fire at proportional ticks through
+    the sim's own fault axes, and a FlightRecorder on replica 0's
+    registry records every `record_every` ticks at virtual seconds
+    (tick * 10ms), phase-stamped at each boundary — so the scorer runs
+    on exactly the history shape the live harness produces.
+
+    Background randomness defaults OFF (crash_probability=0): the
+    timeline's scripted events are the only faults, which keeps a smoke
+    twin's story legible. Same (timeline, seed) => byte-identical
+    committed histories and byte-identical scorecard JSON."""
+
+    from tigerbeetle_tpu.metrics import FlightRecorder
+    from tigerbeetle_tpu.testing.simulator import Simulator
+
+    timeline.validate()
+    ticks = timeline.total_sim_ticks
+    kills = sorted(
+        timeline.event_tick(e) for e in timeline.events
+        if e.kind in ("kill_primary", "gray_primary")
+    )
+    storms = {
+        timeline.event_tick(e): (e.arg or 4) for e in timeline.events
+        if e.kind == "reset_conns"
+    }
+    disk_flip_at = min(
+        (timeline.event_tick(e) for e in timeline.events
+         if e.kind == "disk_fault_on_restart"),
+        default=None,
+    )
+    slow = [e for e in timeline.events if e.kind == "slow_consumer"]
+
+    kwargs = dict(
+        seed=seed,
+        replica_count=replica_count,
+        n_clients=n_clients,
+        ticks=ticks,
+        crash_probability=crash_probability,
+        # scripted timelines own their faults; restart-time WAL faults
+        # only happen when the timeline flips the disk
+        wal_fault_probability=0.0,
+        latency_sample_every=1,
+    )
+    if storms:
+        kwargs["storm_clients"] = max(storms.values())
+    if slow:
+        kwargs["cdc_fanout"] = 3
+        kwargs["cdc_fanout_throttle"] = slow[0].arg or 4
+    kwargs.update(sim_kwargs or {})
+    sim = Simulator(**kwargs)
+    if storms:
+        # the constructor draws a seed-random storm tick; pin it to the
+        # timeline's reset_conns offset instead (still deterministic)
+        sim.storm_tick = min(storms)
+
+    def primary_metrics(s):
+        """The registry worth recording: the current primary's (e2e
+        latency is only observed where replies egress). View-derived,
+        so the choice — and the recorded history — is deterministic;
+        the recorder's swap clamps absorb each re-attach."""
+        views = [
+            s.replicas[i].view for i in range(s.replica_count)
+            if i not in s.down and s.replicas[i].status == "normal"
+        ]
+        p = (max(views) % s.replica_count) if views else 0
+        if p in s.down:
+            p = next(
+                (i for i in range(s.replica_count) if i not in s.down), 0
+            )
+        return s.replicas[p].metrics
+
+    recorder = FlightRecorder(
+        sim.replicas[0].metrics, capacity=max(64, ticks // record_every + 8)
+    )
+    boundaries = {t: p for t, p in timeline.phase_starts_ticks()}
+    kill_at = list(kills)
+    state = {"kills": 0}
+
+    def hook(s: Simulator, now: int) -> None:
+        if now in boundaries:
+            recorder.set_phase(boundaries[now].name, now_s=now * 0.01)
+            for c in s.clients:
+                c.duty = boundaries[now].sim_duty
+        if kill_at and now >= kill_at[0]:
+            kill_at.pop(0)
+            if s.kill_primary(now):
+                state["kills"] += 1
+        if disk_flip_at is not None and now >= disk_flip_at:
+            s.wal_fault_probability = 1.0
+        if now % record_every == 0:
+            # restarts/failovers move the interesting registry: follow
+            # the primary (the recorder clamps the deltas a swap skews)
+            recorder.metrics = primary_metrics(s)
+            recorder.record(now * 0.01)
+
+    sim.tick_hook = hook
+    stats = sim.run()  # raises if any invariant checker trips
+    recorder.metrics = primary_metrics(sim)
+    recorder.record(ticks * 0.01)
+
+    slices = slice_history(recorder.history())
+    checks = {"histories_converged": True, "conservation_ok": True}
+    if slow:
+        checks["cdc_fanout_complete"] = True  # SimCdcFanout._check ran
+    card = score(timeline, slices, checks=checks)
+    return {
+        "stats": stats,
+        "scripted_kills": state["kills"],
+        "history_digest": history_digest(sim.histories),
+        "phase_log": list(recorder.phase_log),
+        "flight_history": recorder.history(),
+        "scorecard": card,
+        "scorecard_json": scorecard_json(card),
+    }
+
+
+# -- canonical timelines -----------------------------------------------
+
+
+def production_day(scale: float = 1.0) -> Timeline:
+    """The canonical day: morning ramp, steady business, a flash crowd
+    onto zipfian-hot accounts with a primary kill mid-spike, a gray
+    primary and connection-reset storm in the afternoon, a disk fault
+    surfacing on the kill's restart, a slow CDC consumer from mid-day,
+    and an evening drain. `scale` multiplies offered rates (live runs
+    tune it to the sandbox's frontier)."""
+
+    def r(x: float) -> float:
+        return round(x * scale, 3)
+
+    phases = (
+        Phase("ramp", 60.0, ("ramp", r(100), r(400)), sim_ticks=900,
+              sim_duty=0.3,
+              slo={"p99_ms": 80.0, "availability": 0.99}),
+        Phase("steady", 90.0, ("steady", r(400)), sim_ticks=1400,
+              sim_duty=0.5,
+              slo={"p99_ms": 60.0, "availability": 0.995,
+                   "shed_rate": 0.01, "cdc_lag_ops": 512}),
+        Phase("flash_crowd", 60.0, ("spike", r(400), r(1200)),
+              sim_ticks=1200, sim_duty=0.9, hot_accounts=16,
+              slo={"p99_ms": 250.0, "availability": 0.97,
+                   "shed_rate": 0.15}),
+        Phase("afternoon", 90.0, ("steady", r(350)), sim_ticks=1400,
+              sim_duty=0.5,
+              slo={"p99_ms": 80.0, "availability": 0.99,
+                   "cdc_lag_ops": 768}),
+        Phase("drain", 30.0, ("ramp", r(300), r(50)), sim_ticks=600,
+              sim_duty=0.2,
+              slo={"p99_ms": 60.0, "availability": 0.995}),
+    )
+    events = (
+        Event(120.0, "slow_consumer", arg=4),
+        Event(175.0, "kill_primary"),
+        Event(176.0, "disk_fault_on_restart"),
+        Event(250.0, "gray_primary", arg=8),
+        Event(280.0, "reset_conns", arg=4),
+    )
+    return Timeline(
+        "production_day", phases, events,
+        slo={"recovery_ms": 10_000.0, "cdc_lag_ops": 4096,
+             "zero_lost": True},
+    ).validate()
+
+
+def smoke_timeline(p99_budget_ms: float = 500.0) -> Timeline:
+    """Tier-1 twin: three short phases, one scripted primary kill in the
+    middle one. `p99_budget_ms` is the warm-up/steady budget — pass a
+    tiny value (e.g. 0.001) to intentionally blow it and watch the
+    scorer fail the row with a named dominant leg."""
+    phases = (
+        Phase("warm", 10.0, ("ramp", 50, 200), sim_ticks=300,
+              sim_duty=0.4, slo={"p99_ms": p99_budget_ms}),
+        Phase("storm", 15.0, ("spike", 200, 600), sim_ticks=500,
+              sim_duty=0.8,
+              slo={"p99_ms": max(p99_budget_ms, 4 * p99_budget_ms)}),
+        Phase("cool", 10.0, ("steady", 100), sim_ticks=300,
+              sim_duty=0.3, slo={"p99_ms": p99_budget_ms}),
+    )
+    events = (Event(17.0, "kill_primary"),)
+    return Timeline(
+        "smoke", phases, events, slo={"zero_lost": True},
+    ).validate()

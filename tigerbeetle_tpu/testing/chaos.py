@@ -62,6 +62,7 @@ from tigerbeetle_tpu.benchmark import (
 from tigerbeetle_tpu.constants import ConfigCluster
 from tigerbeetle_tpu.io.storage import Zone, ZoneLayout
 from tigerbeetle_tpu.metrics import Metrics
+from tigerbeetle_tpu.prodday import RecoveryProbe
 from tigerbeetle_tpu.types import Operation
 from tigerbeetle_tpu.vsr.client import Client, WallTicker
 
@@ -261,17 +262,13 @@ class ChaosFleet:
         # (monotonic, events) per acked batch — the failover bench
         # derives before/after-kill throughput windows from it
         self.acked_timeline: list[tuple[float, int]] = []
-        # Recovery probe: armed at fault time, resolved by the first
-        # reply that PROVES post-fault service — a reply stamped with a
-        # view newer than the fault-time view (a new primary served or
-        # resent it), or a reply to a request ISSUED after the fault.
-        # A bare "next reply" would under-read the metric: bytes the
-        # dead primary wrote to a socket just before the SIGKILL are
-        # still delivered by TCP and would resolve the probe in ~1ms.
-        self._fault_at: float | None = None
-        self._fault_view = 0
-        self._fault_issue_seq = 0
-        self.recoveries_ms: list[float] = []
+        # Recovery probe (tigerbeetle_tpu/prodday.py RecoveryProbe —
+        # the same arithmetic scores the prodday recovery SLO): armed at
+        # fault time, resolved by the first reply that PROVES post-fault
+        # service. recoveries_ms aliases the probe's list (appended in
+        # place, never rebound) so existing readers keep working.
+        self.recovery = RecoveryProbe(self._h_recovery)
+        self.recoveries_ms = self.recovery.recoveries_ms
 
     def pump(self) -> int:
         n = 0
@@ -281,9 +278,7 @@ class ChaosFleet:
 
     def mark_fault(self, now: float) -> None:
         """Arm the time-to-first-commit-after-fault probe."""
-        self._fault_at = now
-        self._fault_view = self.view
-        self._fault_issue_seq = self._issue_seq
+        self.recovery.arm(now, self.view, self._issue_seq)
 
     def step(self, now: float) -> int:
         """One drive turn: pump, tick, harvest replies, feed queues.
@@ -307,14 +302,7 @@ class ChaosFleet:
                         f"({len(body)} bytes of result structs)"
                     )
                 t = time.monotonic()
-                if self._fault_at is not None and (
-                    _h.view > self._fault_view
-                    or s.issue_seq > self._fault_issue_seq
-                ):
-                    ms = (t - self._fault_at) * 1e3
-                    self.recoveries_ms.append(ms)
-                    self._h_recovery.observe(ms)
-                    self._fault_at = None
+                self.recovery.observe_reply(t, _h.view, s.issue_seq)
                 self.acked_events += s.events_inflight
                 self.acked_timeline.append((t, s.events_inflight))
                 s.acked += s.events_inflight

@@ -342,6 +342,11 @@ class SimClient:
         self.tick_burst = tick_burst
 
     drain_mode = False  # heal phase: finish in-flight work, issue nothing new
+    # Issue probability per free-slot tick — the offered-load dial the
+    # prodday twin's phases turn (tigerbeetle_tpu/prodday.py). Changing
+    # duty changes WHICH draws issue work but every draw still happens,
+    # so a timeline's load curve stays seed-deterministic.
+    duty = 0.5
 
     def tick(self, now: int) -> None:
         c = self.client
@@ -366,7 +371,10 @@ class SimClient:
                 c.register()
             return
         if c.in_flight is None:
-            if self.rng.random() < 0.5:
+            # idle when the draw lands below (1 - duty): at the 0.5
+            # default this is bit-for-bit the pre-duty behavior, so
+            # every tuned seed in the test suite replays unchanged
+            if self.rng.random() < 1.0 - self.duty:
                 return  # idle this tick
             self.batch_index += 1
             if self.batch_index % 3 == 1:
@@ -415,6 +423,7 @@ class Simulator:
         client_tick_skew: bool = False,
         primary_crash_probability: float = 0.0,
         latency_sample_every: int = 0,
+        tick_hook=None,
     ):
         from tigerbeetle_tpu.constants import TEST_PROCESS
 
@@ -599,6 +608,13 @@ class Simulator:
             if storm_clients else None
         )
         self._storm_seed = seed
+        # Scripted-scenario seam (tigerbeetle_tpu/prodday.py run_sim_twin):
+        # called as tick_hook(sim, now) at the top of every tick, before
+        # the seeded fault draws — a timeline can set client duty, fire
+        # kill_primary(), flip wal_fault_probability or record a flight
+        # entry at exact tick offsets while staying deterministic (any
+        # rng the hook consumes is the sim's own, in tick order).
+        self.tick_hook = tick_hook
         self._n_clients = n_clients
         # (_client_batch/_workload_knobs were stored above, before the
         # client list — _new_sim_client reads them)
@@ -676,6 +692,30 @@ class Simulator:
         return r
 
     # -- fault scheduling --
+
+    def kill_primary(self, now: int) -> bool:
+        """Scripted targeted crash (the prodday twin's `kill_primary` /
+        `gray_primary` events): SIGKILL the current primary if one is
+        identifiable, up, and quorum can spare it. Unlike the
+        probability-drawn `_maybe_crash` primary fault, this fires at an
+        exact scripted tick; the crash itself still rides `_crash` (torn
+        head, restart delay) so its draws stay in the seed's stream."""
+        active_down = sum(1 for i in self.down if i < self.replica_count)
+        if active_down >= (self.replica_count - 1) // 2:
+            return False
+        views = [
+            self.replicas[i].view
+            for i in range(self.replica_count)
+            if i not in self.down and self.replicas[i].status == "normal"
+        ]
+        if not views:
+            return False
+        primary = max(views) % self.replica_count
+        if primary in self.down:
+            return False
+        self.primary_crashes += 1
+        self._crash(primary, now)
+        return True
 
     def _crash(self, victim: int, now: int) -> None:
         self.crashes += 1
@@ -904,6 +944,8 @@ class Simulator:
     def run(self) -> dict:
         for _ in range(self.ticks_budget):
             now = self.net.tick_now
+            if self.tick_hook is not None:
+                self.tick_hook(self, now)
             self._maybe_crash(now)
             self._maybe_grid_fault()
             self._maybe_restart(now)

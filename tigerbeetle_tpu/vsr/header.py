@@ -107,6 +107,13 @@ class Command(enum.IntEnum):
     # replica that is wedged mid-view-change or mid-recovery.
     request_stats = 28
     stats = 29
+    # Phase marker (scripts/prodday.py, inspect.send_mark): a `mark`
+    # frame carries a phase name in its body; the replica stamps it into
+    # its flight recorder so per-interval history slices by scenario
+    # phase. Served in ANY status (a driver marks phases through faults)
+    # and answered with a small `stats` ack so the driver knows the
+    # boundary landed before offered load changes.
+    mark = 30
 
 
 # Vectorized view of the same layout (batch scans over header rings);

@@ -735,6 +735,9 @@ class Replica:
         if cmd == Command.request_stats:
             self._on_request_stats(header)
             return
+        if cmd == Command.mark:
+            self._on_mark(header, body)
+            return
         if cmd == Command.request_prepare:
             self._on_request_prepare(header)
             return
@@ -1160,6 +1163,23 @@ class Replica:
             body = _json.dumps(snap, sort_keys=True).encode()
         reply = Header(command=int(Command.stats), client=header.client)
         self._send(header.client or header.replica, reply, body)
+
+    def _on_mark(self, header: Header, body: bytes) -> None:
+        """Phase marker (the prodday harness, inspect.send_mark): stamp
+        the named scenario phase into the flight recorder so every
+        subsequent per-interval entry — and therefore the SLO scorer's
+        history slices — carries it. Served in ANY status (the driver
+        marks phase boundaries straight through kills and view changes)
+        and acked with a small `stats` frame so the driver knows the
+        boundary landed before it changes the offered load."""
+        self.metrics.counter("inspect.marks").add()
+        name = body.decode(errors="replace")[:256]
+        snap: dict = {"marked": name, "replica": self.replica}
+        if self.flight_recorder is not None:
+            snap["t"] = self.flight_recorder.set_phase(name)
+        ack = _json.dumps(snap, sort_keys=True).encode()
+        reply = Header(command=int(Command.stats), client=header.client)
+        self._send(header.client or header.replica, reply, ack)
 
     # ------------------------------------------------------------------
     # grid block repair: a corrupt forest block heals from any peer that
