@@ -979,6 +979,43 @@ def bench_frontier(stage) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def bench_cross_ledger(stage) -> dict:
+    """The cross_ledger_tps segment (federation/live.py): two real
+    regions — each a live replica cluster with commitment chains and an
+    AOF-backed CDC tail — with the settlement agent posting mirror/
+    resolve legs between them through the client runtime. Measurement
+    mode runs WITHOUT the region kill (that path is the chaos harness
+    and its tier-1 test); the number is settled origin pendings per
+    wall second of the drive (each one costs a pending + a remote
+    mirror + a resolve, all consensus ops), with the settlement lag
+    bound (ops) and the counterparty commitment-stream audit attached.
+    Host-only (numpy + sockets) like the other live segments."""
+    log = lambda *a: print("[cross_ledger]", *a, file=sys.stderr)  # noqa: E731
+    try:
+        with stage("cross_ledger"):
+            from tigerbeetle_tpu.federation.live import run_federation_chaos
+
+            out = run_federation_chaos(
+                payments=int(os.environ.get("BENCH_CROSS_PAYMENTS", 96)),
+                batch=8,
+                kill_cluster=False,
+                backend=os.environ.get("BENCH_CROSS_BACKEND", "native"),
+                jax_platform=None,  # servers inherit the rig platform
+                log=log,
+            )
+        out["cross_ledger_tps"] = round(
+            out["issued"] / out["drive_wall_s"], 1
+        )
+        out["commitment_verify_ok"] = all(
+            v["checked"] > 0 for v in out["stream_verify"].values()
+        )
+        return out
+    except Exception as e:  # never sink the kernel benchmark
+        print(f"[cross_ledger] FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _parse_trace_arg(argv) -> str | None:
     """`--trace <path>` / `--trace=<path>`: dump a merged Chrome
     trace-event JSON (driver spans + the first e2e server's spans) there."""
@@ -1016,6 +1053,7 @@ def main() -> None:
     ingress = bench_ingress(stage)
     failover = bench_failover(stage)
     frontier = bench_frontier(stage)
+    cross_ledger = bench_cross_ledger(stage)
 
     import jax
     import jax.numpy as jnp
@@ -1315,7 +1353,7 @@ def main() -> None:
     # next to this script plus stderr.
     server_trace_events = e2e.pop("trace_events", None)
     detail = {"durable": e2e, "ingress": ingress, "failover": failover,
-              "frontier": frontier,
+              "frontier": frontier, "cross_ledger": cross_ledger,
               "configs": configs,
               "stages_s": {
                   k: round(v, 2) for k, v in stages.items()
@@ -1481,6 +1519,18 @@ def main() -> None:
                 "frontier_accounted_ratio": (
                     frontier.get("breakdown") or {}
                 ).get("accounted_ratio"),
+                # cross-ledger federation: settled origin pendings per
+                # wall second across two live regions (pending + remote
+                # mirror + resolve per payment), the settlement lag
+                # bound in ops, and the external counterparty audit of
+                # each region's commitment stream; full report in detail
+                "cross_ledger_tps": cross_ledger.get("cross_ledger_tps"),
+                "settlement_lag_ops": cross_ledger.get(
+                    "settlement_lag_max_ops"
+                ),
+                "commitment_verify_ok": cross_ledger.get(
+                    "commitment_verify_ok"
+                ),
                 # device anatomy: commit_wait decomposed on the applier
                 # thread — the slowest sampled apply item's sub-legs must
                 # account for its span exactly (ratio 1.0 at device

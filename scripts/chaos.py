@@ -49,6 +49,14 @@ def main() -> int:
                     help="front every replica with the ingress gateway")
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--deadline", type=float, default=600.0, metavar="S")
+    ap.add_argument("--kill-cluster", action="store_true",
+                    help="federation mode: spawn --federation-regions "
+                         "whole clusters, SIGKILL every replica of one "
+                         "region mid-settlement (federation/live.py)")
+    ap.add_argument("--federation-regions", type=int, default=2)
+    ap.add_argument("--payments", type=int, default=24,
+                    help="cross-region origin pendings per region")
+    ap.add_argument("--commitment-interval", type=int, default=8)
     ap.add_argument("--jax-platform", default="cpu",
                     help="TB_JAX_PLATFORM for the servers ('' = inherit)")
     ap.add_argument("--json", metavar="PATH", default=None,
@@ -62,6 +70,34 @@ def main() -> int:
 
     def log(*a):
         print("[chaos]", *a, file=sys.stderr, flush=True)
+
+    if args.kill_cluster:
+        from tigerbeetle_tpu.federation.live import run_federation_chaos
+
+        report = run_federation_chaos(
+            regions=args.federation_regions,
+            replica_count=args.replicas,
+            payments=args.payments,
+            commitment_interval=args.commitment_interval,
+            restart_after_s=args.restart_after,
+            backend=args.backend,
+            seed=args.seed,
+            deadline_s=args.deadline,
+            jax_platform=args.jax_platform or None,
+            log=log,
+        )
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+            log(f"report -> {args.json}")
+        print(json.dumps(report, indent=1, sort_keys=True))
+        ok = (
+            report["conservation"]["ok"]
+            and all(v["checked"] > 0
+                    for v in report["stream_verify"].values())
+        )
+        log("PASS" if ok else "FAIL")
+        return 0 if ok else 1
 
     report = run_chaos(
         n_sessions=args.sessions,

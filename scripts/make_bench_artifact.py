@@ -51,6 +51,8 @@ def main() -> int:
         incomplete.append("frontier")
     elif len(parsed["frontier_steps"]) < 4:
         incomplete.append("frontier_short_ladder")
+    if parsed.get("cross_ledger_tps") is None:
+        incomplete.append("cross_ledger")
     artifact = wrap_artifact(
         cmd=f"env {env} python bench.py", rc=int(rc), env=env, tail=tail,
         parsed=parsed, segments_incomplete=incomplete,

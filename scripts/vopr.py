@@ -33,6 +33,7 @@ from tigerbeetle_tpu.testing.simulator import (  # noqa: E402
 VERIFY_FRACTION_DEFAULT = 0.25
 CDC_FRACTION_DEFAULT = 0.2
 INGRESS_FRACTION_DEFAULT = 0.15
+FEDERATION_FRACTION_DEFAULT = 0.1
 
 
 def run_seed(seed: int, ticks: int, device_fraction: float,
@@ -40,6 +41,7 @@ def run_seed(seed: int, ticks: int, device_fraction: float,
              verify_fraction: float = VERIFY_FRACTION_DEFAULT,
              cdc_fraction: float = CDC_FRACTION_DEFAULT,
              ingress_fraction: float = INGRESS_FRACTION_DEFAULT,
+             federation_fraction: float = FEDERATION_FRACTION_DEFAULT,
              trace_path: str | None = None,
              hash_log: tuple[str, str] | None = None,
              ) -> tuple[dict | None, str, str | None]:
@@ -52,8 +54,35 @@ def run_seed(seed: int, ticks: int, device_fraction: float,
     proves no gaps / no duplicated effects). An `ingress_fraction` slice
     runs the ingress gateway on every replica (busy-shed admission), a
     seeded connect storm, and the 3-consumer CDC fan-out hub with one
-    throttled consumer (backpressure isolation under the fault mix)."""
+    throttled consumer (backpressure isolation under the fault mix).
+    A `federation_fraction` slice takes the seed WHOLE: the two-region
+    cross-ledger scenario (federation/sim.py — seeded settlement-agent
+    crash/restart, one region killed wholesale mid-settlement,
+    conservation + commitment-stream verification on recovery)."""
     from tigerbeetle_tpu import constants
+
+    if not fixed and (
+        (seed * 3266489917 % 100) < federation_fraction * 100
+    ):
+        # exclusive slice, distinct multiplier (xxhash PRIME32_3)
+        # decorrelating the draw from the VERIFY/CDC/INGRESS ones; the
+        # composite runs its own per-region Simulators, so the usual
+        # topology draw does not apply
+        from tigerbeetle_tpu.federation.sim import run_federation_sim
+
+        desc = "FED 2-region agent-crash region-kill"
+        try:
+            # the settlement drain needs room: floor the tick budget
+            return (
+                run_federation_sim(seed, ticks=max(ticks, 1200)),
+                desc, None,
+            )
+        except Exception as e:  # noqa: BLE001 — report, continue fleet
+            frame = traceback.extract_tb(e.__traceback__)[-1]
+            return None, desc, (
+                f"{type(e).__name__}: {e} "
+                f"[{frame.filename.rsplit('/', 1)[-1]}:{frame.lineno}]"
+            )
 
     if fixed:
         opts: dict = {}
@@ -124,6 +153,11 @@ def main() -> int:
                     help="fraction of seeds run with the ingress gateway, "
                          "a seeded connect storm, and the CDC fan-out hub "
                          "(throttled-consumer isolation)")
+    ap.add_argument("--federation-fraction", type=float,
+                    default=FEDERATION_FRACTION_DEFAULT,
+                    help="fraction of seeds run as the two-region "
+                         "cross-ledger federation scenario (settlement "
+                         "agent crash/restart + region-wide kill)")
     ap.add_argument("--fixed", action="store_true",
                     help="legacy fixed topology (3 replicas / 2 clients)")
     ap.add_argument("--json", default=None,
@@ -158,12 +192,23 @@ def main() -> int:
             verify_fraction=args.verify_fraction,
             cdc_fraction=args.cdc_fraction,
             ingress_fraction=args.ingress_fraction,
+            federation_fraction=args.federation_fraction,
             trace_path=(
                 f"{args.trace}.{seed}.json" if args.trace else None
             ),
             hash_log=hash_log,
         )
-        if err is None:
+        if err is None and "FED" in desc:
+            print(
+                f"seed {seed:6d} ok [{desc}]: "
+                f"committed={stats['committed_ops']} "
+                f"issued={stats['issued']} settled={stats['settled']} "
+                f"voided={stats['voided']} "
+                f"agent_crashes={stats['agent_crashes']} "
+                f"killed=r{stats['region_killed']} "
+                f"lag={stats['settlement_lag_max_ops']}"
+            )
+        elif err is None:
             print(
                 f"seed {seed:6d} ok [{desc}]: "
                 f"committed={stats['committed_ops']:5d} "
@@ -184,6 +229,7 @@ def main() -> int:
                    "verify_fraction": args.verify_fraction,
                    "cdc_fraction": args.cdc_fraction,
                    "ingress_fraction": args.ingress_fraction,
+                   "federation_fraction": args.federation_fraction,
                    "fixed": args.fixed, "ok": err is None}
             if args.trace:
                 # the hub replay re-records the stitched cluster trace
@@ -207,6 +253,8 @@ def main() -> int:
             extra += f" --cdc-fraction {args.cdc_fraction}"
         if args.ingress_fraction != INGRESS_FRACTION_DEFAULT:
             extra += f" --ingress-fraction {args.ingress_fraction}"
+        if args.federation_fraction != FEDERATION_FRACTION_DEFAULT:
+            extra += f" --federation-fraction {args.federation_fraction}"
         if args.fixed:
             extra += " --fixed"
         print("replay failures with: python scripts/vopr.py "

@@ -63,6 +63,7 @@ def replay(rec: dict) -> tuple[bool, str | None]:
     device_fraction/fixed, not the seed alone)."""
     from scripts.vopr import (
         CDC_FRACTION_DEFAULT,
+        FEDERATION_FRACTION_DEFAULT,
         INGRESS_FRACTION_DEFAULT,
         VERIFY_FRACTION_DEFAULT,
         run_seed,
@@ -80,6 +81,9 @@ def replay(rec: dict) -> tuple[bool, str | None]:
         cdc_fraction=rec.get("cdc_fraction", CDC_FRACTION_DEFAULT),
         ingress_fraction=rec.get(
             "ingress_fraction", INGRESS_FRACTION_DEFAULT
+        ),
+        federation_fraction=rec.get(
+            "federation_fraction", FEDERATION_FRACTION_DEFAULT
         ),
         # a fleet run with --trace recorded the stitched cluster trace
         # per seed: the replay dumps its own at a SIBLING path (failing

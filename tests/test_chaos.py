@@ -66,6 +66,33 @@ def test_chaos_smoke_primary_kill_restart(tmp_path):
     assert report["client"]["resends"] > 0
 
 
+def test_chaos_kill_cluster_federation(tmp_path):
+    """Region-level chaos (federation/live.py via --kill-cluster): two
+    real 2-replica clusters with commitment chains and AOF-backed CDC
+    tails, the live settlement agent posting mirror/resolve legs between
+    them, EVERY replica of one region SIGKILLed mid-settlement and
+    restarted from disk. Every origin pending settles (or voids — the
+    bad-beneficiary slice), cross-region conservation holds pairwise,
+    and each region's CDC stream replays clean against the commitment
+    head its replica published at shutdown."""
+    report = _run_chaos_cli(
+        tmp_path,
+        "--kill-cluster", "--replicas", "2", "--payments", "12",
+        "--restart-after", "1.0", "--deadline", "300",
+        timeout=420,
+    )
+    assert report["kills"] == 2 and report["restarts"] == 2
+    assert report["region_killed"] in (0, 1)
+    assert report["issued"] == 2 * 12
+    assert report["settled"] + report["voided"] == report["issued"]
+    assert report["voided"] == report["void_targets"]
+    assert report["conservation"]["ok"]
+    for r in ("0", "1"):
+        assert report["stream_verify"][r]["checked"] > 0
+        assert (report["stream_verify"][r]["head_op"]
+                == report["commitment_heads"][r][0])
+
+
 @pytest.mark.slow
 def test_chaos_full_storm_dual_backend(tmp_path):
     """The acceptance drive: >= 1k multiplexed sessions against a

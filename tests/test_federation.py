@@ -1,0 +1,328 @@
+"""Cross-ledger federation (federation/): commitment-chain determinism,
+the external stream verifier's tamper rejection (naming the exact
+divergent checkpoint), the sans-IO settlement agent's state machine, and
+the seed-deterministic two-region composite scenario.
+
+The composite runs once per module (fixture) and feeds three tests: the
+determinism check re-runs the same seed and compares result dicts
+byte-for-byte; the tamper tests replay the captured region-0 CDC stream
+through `inspect commitments --stream` pristine (accepted, head matches
+the replica's published chain) and edited (rejected at the first
+checkpoint covering the edit)."""
+
+import json
+
+import pytest
+
+import tests.conftest  # noqa: F401 — CPU platform before jax init
+from tigerbeetle_tpu.federation.agent import SettlementCore
+from tigerbeetle_tpu.federation.commitment import (
+    FP_FIELDS,
+    CommitmentLog,
+    CommitmentMismatch,
+    fold_commitment,
+)
+from tigerbeetle_tpu.federation.topology import (
+    FEDERATION_LEDGER,
+    SETTLE_CODE,
+    FederationTopology,
+    escrow_account_id,
+    home_account_id,
+    mirror_account_id,
+    origin_id,
+    settlement_id,
+)
+from tigerbeetle_tpu.types import TransferFlags
+
+SEED = 12  # drawn into the vopr federation slice too (12 * PRIME32_3)
+
+
+def _fp(base: int) -> dict:
+    """A synthetic five-field fingerprint (values just need to differ)."""
+    return {k: base + i for i, k in enumerate(FP_FIELDS)}
+
+
+# -- the chain fold + CommitmentLog --------------------------------------
+
+
+def test_fold_commitment_deterministic_and_sensitive():
+    a = fold_commitment(0, 10, _fp(100))
+    assert a == fold_commitment(0, 10, _fp(100))  # pure
+    assert a != fold_commitment(0, 10, _fp(101))  # fp-sensitive
+    assert a != fold_commitment(0, 20, _fp(100))  # op-sensitive
+    assert a != fold_commitment(1, 10, _fp(100))  # chain-sensitive
+    # extra keys are ignored: only FP_FIELDS participate
+    fat = dict(_fp(100), posted=999, extra=1)
+    assert fold_commitment(0, 10, fat) == a
+
+
+def test_commitment_log_chain_idempotent_and_tamper():
+    log = CommitmentLog(interval=10)
+    c10 = log.record(10, _fp(1))
+    c20 = log.record(20, _fp(2))
+    assert log.head_op == 20 and log.head == c20 and c10 != c20
+    # idempotent re-record (WAL-tail replay): same op, same fp, same value
+    assert log.record(10, _fp(1)) == c10
+    # a tampered re-record names the checkpoint
+    with pytest.raises(CommitmentMismatch) as e:
+        log.record(10, _fp(3))
+    assert e.value.op == 10
+    # boundaries must stay contiguous — a skipped checkpoint is a fault
+    with pytest.raises(CommitmentMismatch) as e:
+        log.record(40, _fp(4))
+    assert e.value.op == 40
+
+
+def test_commitment_log_snapshot_restore_roundtrip():
+    log = CommitmentLog(interval=5)
+    for i in range(1, 7):
+        log.record(5 * i, _fp(i))
+    fresh = CommitmentLog(interval=5)
+    fresh.restore(json.loads(json.dumps(log.snapshot())))  # JSON-safe
+    assert (fresh.head_op, fresh.head) == (log.head_op, log.head)
+    assert fresh.ops() == log.ops()
+    assert fresh.get(15) == log.get(15)
+    # both continue identically from the restored head
+    assert fresh.record(35, _fp(7)) == log.record(35, _fp(7))
+
+
+def test_commitment_log_ring_trims_but_keeps_head():
+    log = CommitmentLog(interval=1, ring=4)
+    for op in range(1, 11):
+        log.record(op, _fp(op))
+    assert len(log.ops()) == 4 and log.ops() == [7, 8, 9, 10]
+    assert log.head_op == 10
+    assert log.get(1) is None
+    # older than the ring: blind-accept (no evidence either way)
+    assert log.record(1, _fp(999)) is None
+
+
+def test_commitment_log_first_divergence():
+    a, b = CommitmentLog(interval=10), CommitmentLog(interval=10)
+    for op in (10, 20):
+        a.record(op, _fp(op))
+        b.record(op, _fp(op))
+    a.record(30, _fp(30))
+    b.record(30, _fp(31))  # state diverged in the third interval
+    a.record(40, _fp(40))
+    b.record(40, _fp(40))  # same input, but the chain stays poisoned
+    assert a.first_divergence(b) == 30
+
+
+# -- chain portability across backends -----------------------------------
+
+
+def test_commitment_chain_backend_parity_native_vs_oracle():
+    """The chain is a pure function of committed history: the native C++
+    engine and the numpy oracle, driven with the SAME batches and
+    timestamps, fold bit-identical commitment chains at every boundary
+    (the external-verifier trust model depends on exactly this)."""
+    from tigerbeetle_tpu.models.native_ledger import NativeLedger
+    from tigerbeetle_tpu.models.oracle import OracleStateMachine
+    from tigerbeetle_tpu.testing.workload import WorkloadGenerator
+
+    gen = WorkloadGenerator(SEED)
+    nat = NativeLedger(12, 14)
+    ora = OracleStateMachine()
+    chain_nat = chain_ora = 0
+    for b in range(8):
+        if b % 3 == 0:
+            op, events = gen.gen_accounts_batch(16)
+        else:
+            op, events = gen.gen_transfers_batch(16)
+        nat.prepare(op, len(events))
+        ts = nat.prepare_timestamp
+        codes_nat = nat.execute_dense(op, ts, list(events))
+        codes_ora = ora.execute_dense(op, ts, list(events))
+        assert [int(c) for c in codes_nat] == [int(c) for c in codes_ora]
+        if b % 2 == 1:  # a checkpoint boundary every second batch
+            chain_nat = fold_commitment(chain_nat, b + 1, nat.fingerprint())
+            chain_ora = fold_commitment(chain_ora, b + 1, ora.fingerprint())
+            assert chain_nat == chain_ora, f"chains diverged at batch {b}"
+    assert chain_nat != 0
+
+
+# -- the settlement agent's sans-IO core ---------------------------------
+
+
+def _outbound_line(op: int, ix: int, seq: int, amount: int,
+                   beneficiary: int, src: int = 0, dst: int = 1) -> str:
+    """A committed origin-pending CDC record leaving region `src`."""
+    return json.dumps({
+        "kind": "transfer", "op": op, "ix": ix, "ts": 1000 + op,
+        "result": 0, "id": origin_id(src, seq),
+        "debit_account_id": home_account_id(src, 0, 2),
+        "credit_account_id": escrow_account_id(src, dst),
+        "amount": amount, "ledger": FEDERATION_LEDGER,
+        "code": SETTLE_CODE, "flags": int(TransferFlags.pending),
+        "user_data_128": beneficiary,
+    })
+
+
+def test_settlement_core_happy_path_posts_both_legs():
+    topo = FederationTopology.of(2)
+    core = SettlementCore(topo, region=0)
+    assert core.emit_lines([_outbound_line(3, 0, seq=1, amount=50,
+                                           beneficiary=77)])
+    assert core.dsts_with_work() == {1}
+    legs = core.next_mirror_batch(1)
+    [t] = core.mirror_transfers(legs)
+    assert t.id == settlement_id(0, 3, 0, 0)
+    assert t.debit_account_id == mirror_account_id(1, 0)
+    assert t.credit_account_id == 77 and t.amount == 50
+    assert t.user_data_128 == origin_id(0, 1) and t.user_data_64 == 3
+    core.on_mirror_replies(legs, [0])
+    legs = core.next_resolve_batch()
+    [r] = core.resolve_transfers(legs)
+    assert r.id == settlement_id(0, 3, 0, 1)
+    assert r.pending_id == origin_id(0, 1) and r.amount == 0
+    assert r.flags == int(TransferFlags.post_pending_transfer)
+    core.on_resolve_replies(legs, [0])
+    assert core.idle() and core.stats["legs_posted"] == 1
+    assert core.watermark() == 3
+
+
+def test_settlement_core_mirror_rejection_voids_origin():
+    topo = FederationTopology.of(2)
+    core = SettlementCore(topo, region=0)
+    core.emit_lines([_outbound_line(4, 0, seq=2, amount=9,
+                                    beneficiary=0xBAD)])
+    legs = core.next_mirror_batch(1)
+    core.on_mirror_replies(legs, [3])  # terminal rejection on dst
+    legs = core.next_resolve_batch()
+    [r] = core.resolve_transfers(legs)
+    assert r.flags == int(TransferFlags.void_pending_transfer)
+    core.on_resolve_replies(legs, [0])
+    assert core.stats["legs_voided"] == 1 and core.stats["legs_posted"] == 0
+
+
+def test_settlement_core_dedup_window_and_gap():
+    topo = FederationTopology.of(2)
+    core = SettlementCore(topo, region=0, window=1)
+    line = _outbound_line(5, 0, seq=3, amount=7, beneficiary=77)
+    assert core.emit_lines([line])
+    # redelivery of an already-staged op is dropped, not double-staged
+    assert core.emit_lines([line])
+    assert core.stats["redeliveries"] == 1 and core.pending_count() == 1
+    # window full: the whole NEXT op is refused before staging anything
+    two = [_outbound_line(6, i, seq=4 + i, amount=1, beneficiary=77)
+           for i in range(2)]
+    assert not core.emit_lines(two)
+    assert core.stats["refusals"] == 1 and core.pending_count() == 1
+    # watermark holds below the unresolved op — the durable cursor may
+    # never overtake in-flight work
+    assert core.watermark() == 4
+    # a gap record poisons a strict core: origin history is gone
+    core.emit_lines([json.dumps({"kind": "gap", "from": 7, "to": 9})])
+    assert core.error is not None and "gap" in core.error
+
+
+def test_settlement_core_ids_deterministic_across_lives():
+    """A crashed agent's replacement re-derives the SAME settlement-leg
+    ids from the redelivered stream — the remote ledger's `exists` result
+    is what makes at-least-once delivery exactly-once in effect."""
+    topo = FederationTopology.of(2)
+    lines = [_outbound_line(8, i, seq=10 + i, amount=5, beneficiary=77)
+             for i in range(3)]
+    ids = []
+    for _life in range(2):
+        core = SettlementCore(topo, region=0)
+        core.emit_lines(lines)
+        legs = core.next_mirror_batch(1)
+        ids.append([t.id for t in core.mirror_transfers(legs)])
+    assert ids[0] == ids[1] and len(set(ids[0])) == 3
+
+
+# -- the two-region composite scenario -----------------------------------
+
+
+@pytest.fixture(scope="module")
+def fed_run():
+    from tigerbeetle_tpu.federation.sim import SimFederation
+
+    fed = SimFederation(SEED, ticks=1200)
+    return fed, fed.run()
+
+
+def test_sim_federation_scenario_green(fed_run):
+    _, result = fed_run
+    assert result["regions"] == 2
+    assert result["issued"] > 0
+    assert result["settled"] + result["voided"] >= result["issued"]
+    assert result["agent_crashes"] > 0  # the schedule actually fired
+    assert result["region_killed"] in (0, 1)
+    assert result["conservation"]["ok"]
+    for region in (0, 1):
+        assert result["stream_verify"][region]["checked"] > 0
+
+
+def test_sim_federation_seed_deterministic(fed_run):
+    """Same seed ⇒ byte-identical composite result: committed ops,
+    settlement counts, the region kill, both commitment chains, and the
+    verifier heads all reproduce (this is what makes a vopr federation
+    seed replayable)."""
+    from tigerbeetle_tpu.federation.sim import run_federation_sim
+
+    _, first = fed_run
+    assert run_federation_sim(SEED, ticks=1200) == first
+
+
+def _write_stream(fed, region: int, path) -> list[int]:
+    """Dump a region's captured CDC stream to JSONL; return the
+    checkpoint (commitment-record) ops in order."""
+    boundary_ops = []
+    with open(path, "w") as f:
+        for op in sorted(fed.streams[region]):
+            for ln in fed.streams[region][op]:
+                rec = json.loads(ln)
+                if rec.get("kind") == "commitment":
+                    boundary_ops.append(int(rec["op"]))
+                f.write(ln.strip() + "\n")
+    return boundary_ops
+
+
+def test_inspect_commitments_stream_accepts_pristine(fed_run, tmp_path,
+                                                     capsys):
+    from tigerbeetle_tpu.cli import main
+
+    fed, result = fed_run
+    path = tmp_path / "region0.jsonl"
+    assert _write_stream(fed, 0, path)
+    assert main(["inspect", "commitments", "--stream", str(path),
+                 "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] and report["checked"] > 0
+    assert report["head_op"] == result["commitment_heads"][0][0]
+    assert int(report["head"]) == result["commitment_heads"][0][1]
+
+
+def test_inspect_commitments_stream_rejects_tamper(fed_run, tmp_path,
+                                                   capsys):
+    """Edit one committed transfer amount in the stream: the verifier
+    must reject, naming the FIRST checkpoint whose commitment covers the
+    edited op — not merely 'somewhere', the exact boundary."""
+    from tigerbeetle_tpu.cli import main
+
+    fed, _ = fed_run
+    path = tmp_path / "region0_tampered.jsonl"
+    boundary_ops = _write_stream(fed, 0, path)
+    lines = path.read_text().splitlines()
+    target_op = None
+    for i, ln in enumerate(lines):
+        rec = json.loads(ln)
+        if (rec.get("kind") == "transfer" and rec.get("result") == 0
+                and rec.get("amount", 0) > 0
+                and rec["op"] <= boundary_ops[-1]):
+            rec["amount"] = int(rec["amount"]) + 1
+            lines[i] = json.dumps(rec)
+            target_op = int(rec["op"])
+            break
+    assert target_op is not None
+    path.write_text("\n".join(lines) + "\n")
+    assert main(["inspect", "commitments", "--stream", str(path),
+                 "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert not report["ok"]
+    expected = min(op for op in boundary_ops if op >= target_op)
+    assert report["first_divergent"] == expected
+    assert str(expected) in report["error"]

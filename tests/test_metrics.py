@@ -661,3 +661,49 @@ def test_statsd_histogram_percentiles_and_count_deltas():
     assert "tb.commit_us.count:1|c" in lines
     s.close()
     sink.close()
+
+
+def test_federation_metric_names_all_cataloged():
+    """Every metric the settlement agent's core registers must be in
+    metrics.CATALOG (the federation.* section) so [stats] and --statsd
+    emit them without unknown-metric fallbacks — the same drift guard
+    the cdc.*/chaos.* names have."""
+    import json
+
+    from tigerbeetle_tpu.federation.agent import SettlementCore
+    from tigerbeetle_tpu.federation.topology import (
+        FEDERATION_LEDGER,
+        SETTLE_CODE,
+        FederationTopology,
+        escrow_account_id,
+        origin_id,
+    )
+    from tigerbeetle_tpu.metrics import CATALOG, Metrics
+    from tigerbeetle_tpu.types import TransferFlags
+
+    m = Metrics()
+    core = SettlementCore(FederationTopology.of(2), region=0, window=1,
+                          metrics=m)
+    line = json.dumps({
+        "kind": "transfer", "op": 2, "ix": 0, "ts": 1002, "result": 0,
+        "id": origin_id(0, 1), "debit_account_id": 7,
+        "credit_account_id": escrow_account_id(0, 1), "amount": 5,
+        "ledger": FEDERATION_LEDGER, "code": SETTLE_CODE,
+        "flags": int(TransferFlags.pending), "user_data_128": 9,
+    })
+    assert core.emit_lines([line])
+    # window full -> the next op is refused (registers the refusal
+    # counter), then drive the staged leg through to posted
+    assert not core.emit_lines([line.replace('"op": 2', '"op": 3')])
+    legs = core.next_mirror_batch(1)
+    core.on_mirror_replies(legs, [0])
+    core.on_resolve_replies(core.next_resolve_batch(), [0])
+    snap = m.snapshot()
+    emitted = set(snap["counters"]) | set(snap["gauges"])
+    fed = {n for n in emitted if n.startswith("federation.")}
+    assert fed, "the core registered no federation.* metrics"
+    missing = fed - set(CATALOG)
+    assert not missing, f"federation names missing from CATALOG: {missing}"
+    for name in fed:
+        kind, _unit, help_ = CATALOG[name]
+        assert help_, name

@@ -66,22 +66,26 @@ def test_replay_passes_recorded_verify_fraction(monkeypatch):
 
     def fake_run_seed(seed, ticks, device_fraction=0.0, fixed=False,
                       verify_fraction=None, cdc_fraction=None,
-                      ingress_fraction=None, trace_path=None):
+                      ingress_fraction=None, federation_fraction=None,
+                      trace_path=None):
         seen.update(seed=seed, verify_fraction=verify_fraction,
                     cdc_fraction=cdc_fraction,
                     ingress_fraction=ingress_fraction,
+                    federation_fraction=federation_fraction,
                     trace_path=trace_path)
         return None, "r3", None
 
     monkeypatch.setattr(vopr_mod, "run_seed", fake_run_seed)
     rec = {"seed": 7, "ticks": 50, "topology": "r3 c2",
            "verify_fraction": 0.6, "cdc_fraction": 0.5,
-           "ingress_fraction": 0.4, "trace": "/tmp/t.7.json",
+           "ingress_fraction": 0.4, "federation_fraction": 0.3,
+           "trace": "/tmp/t.7.json",
            "ok": False, "error": "X"}
     replay(rec)
     assert seen["verify_fraction"] == 0.6
     assert seen["cdc_fraction"] == 0.5
     assert seen["ingress_fraction"] == 0.4
+    assert seen["federation_fraction"] == 0.3
     # a fleet run with --trace recorded the per-seed stitched trace
     # path: the replay dumps at a SIBLING path so a diverging replay
     # stays diffable against the fleet's original artifact
@@ -92,7 +96,48 @@ def test_replay_passes_recorded_verify_fraction(monkeypatch):
     assert seen["verify_fraction"] == vopr_mod.VERIFY_FRACTION_DEFAULT
     assert seen["cdc_fraction"] == vopr_mod.CDC_FRACTION_DEFAULT
     assert seen["ingress_fraction"] == vopr_mod.INGRESS_FRACTION_DEFAULT
+    assert (seen["federation_fraction"]
+            == vopr_mod.FEDERATION_FRACTION_DEFAULT)
     assert seen["trace_path"] is None
+
+
+def test_federation_slice_routes_to_federation_sim(monkeypatch):
+    """The federation draw is EXCLUSIVE: a drawn seed runs the two-region
+    composite (federation/sim.py) instead of a single Simulator, tagged
+    FED in the topology line; fraction 0 disables the slice entirely. The
+    draw uses a distinct multiplier, so it must be decorrelated from the
+    VERIFY/CDC/INGRESS draws (not a subset/superset of any of them)."""
+    import scripts.vopr as vopr_mod
+    from tigerbeetle_tpu.federation import sim as fed_sim
+
+    called = {}
+
+    def fake_fed_sim(seed, ticks=0):
+        called.update(seed=seed, ticks=ticks)
+        return {"seed": seed, "issued": 0}
+
+    monkeypatch.setattr(fed_sim, "run_federation_sim", fake_fed_sim)
+    drawn = [s for s in range(1, 400)
+             if (s * 3266489917) % 100 < 10]
+    assert 30 <= len(drawn) <= 50  # ~10% of seeds
+    seed = drawn[0]
+    stats, desc, err = vopr_mod.run_seed(
+        seed, ticks=50, device_fraction=0.0, fixed=False)
+    assert err is None and "FED" in desc
+    assert called["seed"] == seed
+    assert called["ticks"] >= 1200  # floor: the drain needs room
+    # fraction 0 turns the slice off — the seed runs the normal draw
+    called.clear()
+    _, desc0, _ = vopr_mod.run_seed(
+        seed, ticks=5, device_fraction=0.0, fixed=False,
+        federation_fraction=0.0)
+    assert "FED" not in desc0 and not called
+    # decorrelation: the FED set is not nested in any sibling slice
+    for mult, frac in ((2654435761, 0.25), (2246822519, 0.2),
+                       (2166136261, 0.15)):
+        other = {s for s in range(1, 400) if (s * mult) % 100 < frac * 100}
+        assert not set(drawn) <= other
+        assert not other <= set(drawn)
 
 
 def test_hub_clean_fleet_exits_zero(tmp_path):

@@ -106,6 +106,16 @@ def encode_batch(header, body: bytes, reply_body: bytes | None) -> list[dict]:
                 "user_data_32": int(r["user_data_32"]),
                 "resolved": code is not None,
             }
+            # event balance fields: zero on every VALID create, but the
+            # validation family (debits_posted_must_be_zero & friends)
+            # rejects on them — a stream replayer can only reproduce
+            # those result codes if the record carries the fields
+            for field in (
+                "debits_pending", "debits_posted",
+                "credits_pending", "credits_posted",
+            ):
+                rec[field] = join_u128(r[field + "_lo"], r[field + "_hi"])
+            rec["reserved"] = int(r["reserved"])
             out.append(rec)
         return out
     for i in range(n):
@@ -126,6 +136,7 @@ def encode_batch(header, body: bytes, reply_body: bytes | None) -> list[dict]:
             "credit_account_id": credit,
             "amount": amount,
             "pending_id": join_u128(r["pending_id_lo"], r["pending_id_hi"]),
+            "timeout": int(r["timeout"]),
             "ledger": int(r["ledger"]),
             "code": int(r["code"]),
             "flags": flags,
@@ -155,6 +166,21 @@ def encode_batch(header, body: bytes, reply_body: bytes | None) -> list[dict]:
                 ]
         out.append(rec)
     return out
+
+
+def commitment_record(op: int, commitment: int, prev: int) -> dict:
+    """Checkpoint state-commitment record (federation/commitment.py):
+    the chained digest of the ledger's state fingerprint at boundary
+    `op`. A consumer replaying the stream through its own state machine
+    recomputes the chain and rejects a tampered stream/state naming this
+    exact checkpoint. Defined here (not in federation/) so the encoder
+    module owns every stream record kind without importing upward."""
+    return {
+        "kind": "commitment",
+        "op": op,
+        "commitment": commitment,
+        "prev": prev,
+    }
 
 
 def gap_record(from_op: int, to_op: int) -> dict:

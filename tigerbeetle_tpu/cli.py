@@ -64,6 +64,10 @@ class StartArgs:
     cdc_udp: str = ""  # change-stream UDP host | :port | host:port
     cdc_cursor: str = ""  # cursor file (default: <cdc-jsonl>.cursor)
     cdc_window: int = 256  # live in-flight window (ops)
+    # Ops between durable-cursor acks. Every ack flushes the sink first,
+    # so this is ALSO the staleness bound an external tailer of the JSONL
+    # file sees; the live federation agent runs 1 (flush per op).
+    cdc_ack_interval: int = 32
     # Deliberately slow consumer model (bench A/B): the sink accepts at
     # most one op's records per this many microseconds, REFUSING (not
     # sleeping) in between — backpressure without blocking the loop.
@@ -153,6 +157,21 @@ class StartArgs:
     # our spans (the directory also gets device_trace_meta.json).
     device_trace: str = ""
     device_trace_s: float = 3.0  # window length (seconds)
+    # Checkpoint state commitments (federation/commitment.py): fold the
+    # ledger's state fingerprint into a hash chain at every op multiple
+    # of this interval. The chain rides checkpoints (restart-stable),
+    # the [stats] snapshot, `inspect commitments`, and — when a CDC sink
+    # is attached — the change stream itself as `commitment` records an
+    # external consumer verifies with `inspect commitments --stream`.
+    # 0 disables.
+    commitment_interval: int = 0
+    # Cross-ledger federation identity (federation/topology.py): which
+    # region of an N-region federation this cluster is. Purely
+    # declarative on the server (settlement runs in the agent process —
+    # scripts/federate.py), but stamped into the [stats] snapshot so
+    # operators and the live harness can tell regions apart.
+    federation_region: int = -1
+    federation_regions: int = 0
 
 
 @dataclasses.dataclass
@@ -170,7 +189,8 @@ class InspectArgs:
     running server (--addresses)."""
 
     topic: str = positional(
-        "superblock | wal | replies | grid | lsm | client-table | all | live"
+        "superblock | wal | replies | grid | lsm | client-table | all | "
+        "live | commitments"
     )
     file: str = dataclasses.field(
         default="", metadata={"positional": True,
@@ -191,6 +211,12 @@ class InspectArgs:
     clients_max: int = 32
     client_reply_slots: int = 0
     forest_blocks: int = 0  # LSM forest geometry (spill-enabled files)
+    # `commitments` topic, verify mode: replay this CDC stream JSONL
+    # through a fresh oracle and re-derive the commitment chain — a
+    # tampered stream/state fails naming the exact checkpoint. With
+    # --addresses instead, reads the live chain off the [stats] wire;
+    # with a data file, decodes the checkpointed chain offline.
+    stream: str = ""
 
 
 @dataclasses.dataclass
@@ -218,6 +244,17 @@ class ChaosArgs:
     seed: int = 1
     deadline_s: float = 600.0
     json: str = ""  # write the full report here too
+    # Region-level federation mode (federation/live.py): spawn
+    # --federation-regions whole clusters, run the live settlement agent
+    # between them, SIGKILL EVERY replica of one region mid-settlement,
+    # restart it from disk, and verify cross-region conservation plus
+    # each region's commitment stream against its published head. The
+    # per-session workload knobs above don't apply; `payments` origin
+    # pendings are issued per region.
+    kill_cluster: bool = False
+    federation_regions: int = 2
+    payments: int = 24
+    commitment_interval: int = 8
 
 
 @dataclasses.dataclass
@@ -455,6 +492,12 @@ def cmd_start(args) -> int:
         replica.fuse_window_ns = 2_000_000
     else:
         replica.fuse_window_ns = args.fuse_window_us * 1000
+    if args.commitment_interval > 0:
+        from tigerbeetle_tpu.federation.commitment import CommitmentLog
+
+        # install BEFORE open(): the chain restores from the checkpoint
+        # meta, then WAL replay re-records the tail idempotently
+        replica.commitment_log = CommitmentLog(args.commitment_interval)
     hash_log = None
     if args.hash_log:
         from tigerbeetle_tpu.testing.hash_log import HashLog, parse_hash_log_spec
@@ -509,7 +552,9 @@ def cmd_start(args) -> int:
             )
             for name, sink in named:
                 cdc_pump.add_consumer(
-                    name, sink, FileCursor(f"{cursor_file}.{name}")
+                    name, sink, FileCursor(f"{cursor_file}.{name}"),
+                    ack_interval=args.cdc_ack_interval,
+                    commitments=args.commitment_interval > 0,
                 )
         else:
             sink = (
@@ -519,10 +564,12 @@ def cmd_start(args) -> int:
             cdc_pump = CdcPump(
                 replica, sink, FileCursor(cursor_file),
                 window=args.cdc_window,
+                ack_interval=args.cdc_ack_interval,
                 # the AOF (when on) is the deep-resume source: ops older
                 # than the WAL ring replay through the oracle with exact
                 # results
                 aof_path=args.aof or None,
+                commitments=args.commitment_interval > 0,
             )
         # attach BEFORE open(): single-replica recovery re-commits the
         # journal tail, and those redeliveries are exactly what the
@@ -631,6 +678,15 @@ def cmd_start(args) -> int:
             # the scenario-phase timeline (prodday `mark` markers): when
             # each phase of the scripted run began, by the recorder clock
             stats["phases"] = flight.phase_log
+        if replica.commitment_log is not None:
+            # checkpoint state-commitment chain head + recent entries —
+            # the same surface `inspect commitments` reads live
+            stats["commitments"] = replica.commitment_log.stats_snapshot()
+        if args.federation_regions:
+            stats["federation"] = {
+                "region": args.federation_region,
+                "regions": args.federation_regions,
+            }
         _lmod = sys.modules.get("tigerbeetle_tpu.models.ledger")
         if _lmod is not None:
             # compile-sentinel totals + bounded event log (post-warmup
@@ -849,6 +905,35 @@ def cmd_chaos(args) -> int:
 
     from tigerbeetle_tpu.testing.chaos import CHAOS_ACTIONS, run_chaos
 
+    if args.kill_cluster:
+        from tigerbeetle_tpu.federation.live import run_federation_chaos
+
+        def fed_log(*a):
+            print("[chaos]", *a, file=sys.stderr, flush=True)
+
+        report = run_federation_chaos(
+            regions=args.federation_regions,
+            replica_count=args.replicas,
+            payments=args.payments,
+            commitment_interval=args.commitment_interval,
+            restart_after_s=args.restart_after_s,
+            backend=args.backend, seed=args.seed,
+            deadline_s=args.deadline_s,
+            jax_platform=None,  # the CLI inherits the ambient platform
+            log=fed_log,
+        )
+        if args.json:
+            with open(args.json, "w") as f:
+                _json.dump(report, f, indent=1, sort_keys=True)
+        print(_json.dumps(report, indent=1, sort_keys=True))
+        ok = (
+            report["conservation"]["ok"]
+            and all(
+                v["checked"] > 0 for v in report["stream_verify"].values()
+            )
+        )
+        return 0 if ok else 1
+
     faults = tuple(f for f in args.faults.split(",") if f)
     for f in faults:
         if f not in CHAOS_ACTIONS:
@@ -960,11 +1045,44 @@ def cmd_inspect(args) -> int:
             _inspect.render(topic, report, sys.stdout)
 
     topics = ("superblock", "wal", "replies", "grid", "lsm",
-              "client-table", "all", "live")
+              "client-table", "all", "live", "commitments")
     if args.topic not in topics:
         flags.fatal(
             f"unknown inspect topic {args.topic!r} ({' | '.join(topics)})"
         )
+    if args.topic == "commitments":
+        if args.stream:
+            # external-consumer verify: replay the stream, re-derive the
+            # chain, reject tampering at the exact checkpoint
+            report = _inspect.verify_commitment_stream(args.stream)
+            emit("commitments", report)
+            return 0 if report["ok"] else 1
+        if args.addresses:
+            host, sep, port = args.addresses.strip().rpartition(":")
+            if not sep or not port.isdigit():
+                flags.fatal("inspect commitments needs --addresses host:port")
+            live = _inspect.inspect_live(host or "127.0.0.1", int(port))
+            report = _inspect.commitments_from_stats(live)
+            emit("commitments", report)
+            return 0 if report.get("enabled") else 1
+        if not args.file:
+            flags.fatal(
+                "inspect commitments needs a data file, --addresses, or "
+                "--stream"
+            )
+        cluster_cfg = ConfigCluster(
+            clients_max=args.clients_max,
+            client_reply_slots=args.client_reply_slots,
+        )
+        storage = _inspect.open_storage(
+            args.file, cluster_cfg, forest_blocks=args.forest_blocks
+        )
+        try:
+            report = _inspect.inspect_commitments_offline(storage)
+        finally:
+            storage.close()
+        emit("commitments", report)
+        return 0 if report.get("enabled") else 1
     if args.topic == "live":
         # a replica has no default port, so one is mandatory (`:3001`
         # and `host:3001` both work; statsd.parse_addr is wrong here —

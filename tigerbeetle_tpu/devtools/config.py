@@ -79,6 +79,9 @@ class VetConfig:
         # and the live driver's clock reads must be baselined with whys
         "tigerbeetle_tpu/prodday.py",
         "scripts/prodday.py",
+        # the federation composite: per-region Simulators + the sans-IO
+        # settlement agent, all tick-driven — no wall clock anywhere
+        "tigerbeetle_tpu/federation/sim.py",
     )
     clock_seam: frozenset = frozenset({
         # THE seam: RealTime wraps the OS clocks, DeterministicTime the
@@ -118,6 +121,10 @@ class VetConfig:
         "tigerbeetle_tpu/client_ffi.py":
             "FFI client binding (session nonces from OS entropy): prod "
             "client surface, the sim drives vsr/client.py directly",
+        "tigerbeetle_tpu/federation/live.py":
+            "live two-region driver: subprocess clusters, JSONL tailing "
+            "and settlement on wall time; the sim twin is federation/"
+            "sim.py on ticks",
     })
     # the executor seam itself + the WAL writer pool: the modules that
     # OWN thread construction behind deterministic alternatives

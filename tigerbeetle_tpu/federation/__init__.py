@@ -1,0 +1,49 @@
+"""Cross-ledger federation: N regional clusters, settled through CDC.
+
+A federation is N independent VSR clusters ("regions"), each owning the
+accounts that hash to it. Cross-region transfers never run global
+consensus: the origin region commits a two-phase PENDING leg against a
+per-pair escrow account, a settlement agent (a CDC consumer) mirrors the
+leg on the destination region, then posts or voids the origin pending —
+at-least-once, idempotent, resumable from a durable cursor. Checkpoint
+state commitments (a chained digest over the ledger's groove-row
+fingerprints) let a counterparty verify a region's stream against its
+published state without trusting it.
+
+Module map:
+
+- `commitment`: CommitmentLog (the per-replica checkpoint chain) and
+  StreamVerifier (the external consumer's replay-and-check).
+- `topology`: declarative region topology — owner-hash routing,
+  escrow/mirror account derivation, deterministic settlement ids.
+- `agent`: SettlementCore, the sans-IO settlement state machine that
+  rides a CdcPump sink on one side and two client runtimes on the other.
+- `sim`: SimFederation — the seed-deterministic multi-region simulator
+  scenario (region kill mid-settlement, conservation proven on recovery).
+- `live`: the wall-clock two-region driver (subprocess clusters), used
+  by `scripts/federate.py` and the chaos harness's `--kill-cluster`.
+"""
+
+from tigerbeetle_tpu.federation.commitment import (
+    CommitmentLog,
+    CommitmentMismatch,
+    StreamVerifier,
+    fold_commitment,
+)
+from tigerbeetle_tpu.federation.topology import (
+    FEDERATION_LEDGER,
+    SETTLE_CODE,
+    FederationTopology,
+    RegionSpec,
+)
+
+__all__ = [
+    "CommitmentLog",
+    "CommitmentMismatch",
+    "StreamVerifier",
+    "fold_commitment",
+    "FederationTopology",
+    "RegionSpec",
+    "FEDERATION_LEDGER",
+    "SETTLE_CODE",
+]

@@ -1,0 +1,329 @@
+"""The settlement agent: a CDC consumer that settles cross-region legs.
+
+Sans-IO core. `SettlementCore` plugs into a CdcPump (or fan-out hub) AS
+THE SINK on the origin region's committed stream, recognizes outbound
+two-phase pendings (topology.classify_outbound), and stages the two
+settlement legs per origin event:
+
+    leg 0 (mirror):  a plain posted transfer on the DESTINATION region
+                     (debit the pair mirror, credit the beneficiary)
+    leg 1 (resolve): post_pending of the origin on the ORIGIN region —
+                     or void_pending when the mirror leg failed
+                     terminally (e.g. the beneficiary does not exist)
+
+Drivers (federation/sim.py tick-based, federation/live.py wall-clock)
+own the client runtimes and the loop: they pull staged batches, send
+them through the PR 10 fault-tolerant clients, and feed replies back.
+The core never reads a clock and never talks to a socket, so the sim
+scenario replays it byte-identically.
+
+Delivery contract — at-least-once, exactly-once effects:
+
+- Backpressure BEFORE staging: `emit_lines` refuses the whole op when
+  the in-flight window is full; the pump retries it later (the tail
+  still holds the op). An accepted op is staged atomically.
+- Settlement-leg ids are a pure function of (src region, origin op,
+  event index, leg) — the REMOTE ledger is the dedup authority. After a
+  crash the agent replays from its cursor and re-sends legs; `exists`
+  (and the already_posted/already_voided family on resolves) counts as
+  success, so redelivery never double-moves money.
+- The durable cursor is held back (`HoldbackCursor`) to the settlement
+  watermark: it only persists ops whose every staged leg has resolved,
+  so a crash between cursor write and leg completion is impossible —
+  the replay window always covers unfinished work.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Set
+
+from tigerbeetle_tpu.federation.topology import (
+    FEDERATION_LEDGER,
+    SETTLE_CODE,
+    FederationTopology,
+    settlement_id,
+)
+from tigerbeetle_tpu.types import CreateTransferResult as R
+from tigerbeetle_tpu.types import Transfer, TransferFlags
+
+# Mirror-leg replies that mean "the money is on the destination":
+_MIRROR_OK = (int(R.ok), int(R.exists))
+# Resolve-leg replies that mean "the origin pending is closed":
+_RESOLVE_DONE = (
+    int(R.ok),
+    int(R.exists),
+    int(R.pending_transfer_already_posted),
+    int(R.pending_transfer_already_voided),
+    # the pending was resolved the other way by an earlier incarnation
+    # (post raced a void or vice versa): closed either way
+    int(R.exists_with_different_flags),
+)
+
+
+class _Leg:
+    """One origin event's settlement in flight."""
+
+    __slots__ = (
+        "op", "ix", "origin_id", "beneficiary", "amount", "src", "dst",
+        "phase", "void", "in_flight",
+    )
+
+    def __init__(self, op, ix, origin_id, beneficiary, amount, src, dst):
+        self.op = op
+        self.ix = ix
+        self.origin_id = origin_id
+        self.beneficiary = beneficiary
+        self.amount = amount
+        self.src = src
+        self.dst = dst
+        self.phase = "mirror"  # -> "resolve" -> "done"
+        self.void = False
+        self.in_flight = False
+
+
+class HoldbackCursor:
+    """Durable-cursor wrapper that defers persistence to the settlement
+    watermark. The pump acks as it streams; this class stashes those
+    acks and `release(watermark)` persists only the highest stashed op
+    at or below the watermark — at-least-once redelivery of every op
+    with unfinished legs is guaranteed across SIGKILL."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._stash: List[tuple] = []  # (op, checksum), ascending
+        self._released = inner.load()[0]
+
+    def load(self):
+        return self.inner.load()
+
+    def ack(self, op: int, checksum: int) -> None:
+        if not self._stash or op > self._stash[-1][0]:
+            self._stash.append((op, checksum))
+
+    def release(self, watermark: int) -> None:
+        best = None
+        while self._stash and self._stash[0][0] <= watermark:
+            best = self._stash.pop(0)
+        if best is not None and best[0] > self._released:
+            self.inner.ack(best[0], best[1])
+            self._released = best[0]
+
+
+class SettlementCore:
+    """The agent's state machine (see module docstring). One instance
+    per (origin region, federation); drivers may run one per region."""
+
+    def __init__(
+        self,
+        topology: FederationTopology,
+        region: int,
+        window: int = 64,
+        verifier=None,
+        metrics=None,
+        strict_gaps: bool = True,
+    ):
+        self.topology = topology
+        self.region = region
+        self.window = window
+        self.verifier = verifier  # optional StreamVerifier fed every line
+        self.strict_gaps = strict_gaps
+        self.error: Optional[str] = None
+        self._legs: Dict[tuple, _Leg] = {}  # (op, ix) -> unfinished leg
+        self._ingested_op = 0  # staging high-water (intra-life dedup)
+        self._last_seen_op = 0
+        self.stats = {
+            "outbound_seen": 0,
+            "legs_posted": 0,
+            "legs_voided": 0,
+            "redeliveries": 0,
+            "refusals": 0,
+            "anomalies": 0,
+        }
+        self._metrics = None
+        if metrics is not None:
+            self._metrics = {
+                "inflight": metrics.gauge("federation.inflight_legs"),
+                "posted": metrics.counter("federation.legs_posted"),
+                "voided": metrics.counter("federation.legs_voided"),
+                "outbound": metrics.counter("federation.outbound_seen"),
+                "refusals": metrics.counter("federation.sink_refusals"),
+                "anomalies": metrics.counter("federation.anomalies"),
+            }
+
+    # -- sink protocol (called by the pump, one call per op) -----------
+
+    def emit_lines(self, lines: Iterable[str]) -> bool:
+        recs = [json.loads(ln) for ln in lines]
+        if self.verifier is not None:
+            for r in recs:
+                self.verifier.feed(r)
+        staged = []
+        op = None
+        for rec in recs:
+            kind = rec.get("kind")
+            if kind == "gap":
+                if self.strict_gaps and self.error is None:
+                    self.error = (
+                        f"stream gap {rec.get('from')}..{rec.get('to')}: "
+                        "origin history lost (run the origin with an AOF)"
+                    )
+                continue
+            if kind != "transfer":
+                continue
+            op = int(rec["op"])
+            if op <= self._ingested_op:
+                self.stats["redeliveries"] += 1
+                continue  # this life already staged it
+            out = self.topology.classify_outbound(rec, self.region)
+            if out is None:
+                continue
+            staged.append(_Leg(
+                op=op,
+                ix=int(rec["ix"]),
+                origin_id=int(rec["id"]),
+                beneficiary=out["beneficiary"],
+                amount=out["amount"],
+                src=self.region,
+                dst=out["dst"],
+            ))
+        if staged and len(self._legs) + len(staged) > self.window:
+            # refuse BEFORE staging: the pump retries the whole op once
+            # the window drains — an accepted op is staged atomically
+            self.stats["refusals"] += 1
+            if self._metrics:
+                self._metrics["refusals"].add()
+            return False
+        for leg in staged:
+            self._legs[(leg.op, leg.ix)] = leg
+        self.stats["outbound_seen"] += len(staged)
+        if self._metrics:
+            if staged:
+                self._metrics["outbound"].add(len(staged))
+            self._metrics["inflight"].set(len(self._legs))
+        if op is not None:
+            self._ingested_op = max(self._ingested_op, op)
+            self._last_seen_op = max(self._last_seen_op, op)
+        return True
+
+    def flush(self) -> None:  # sink protocol (durability lives remote)
+        pass
+
+    # -- driver side: staged work --------------------------------------
+
+    def dsts_with_work(self) -> Set[int]:
+        return {
+            leg.dst
+            for leg in self._legs.values()
+            if leg.phase == "mirror" and not leg.in_flight
+        }
+
+    def next_mirror_batch(self, dst: int, limit: int = 32) -> List[_Leg]:
+        out = []
+        for key in sorted(self._legs):
+            leg = self._legs[key]
+            if leg.phase == "mirror" and not leg.in_flight and leg.dst == dst:
+                leg.in_flight = True
+                out.append(leg)
+                if len(out) >= limit:
+                    break
+        return out
+
+    def mirror_transfers(self, legs: List[_Leg]) -> List[Transfer]:
+        return [
+            Transfer(
+                id=settlement_id(leg.src, leg.op, leg.ix, 0),
+                debit_account_id=self.topology.mirror(leg.dst, leg.src),
+                credit_account_id=leg.beneficiary,
+                amount=leg.amount,
+                ledger=FEDERATION_LEDGER,
+                code=SETTLE_CODE,
+                user_data_128=leg.origin_id,
+                user_data_64=leg.op,
+                user_data_32=leg.ix,
+            )
+            for leg in legs
+        ]
+
+    def on_mirror_replies(self, legs: List[_Leg], codes: List[int]) -> None:
+        for leg, code in zip(legs, codes):
+            leg.in_flight = False
+            if leg.phase != "mirror":
+                continue
+            leg.phase = "resolve"
+            # any terminal rejection of the mirror (beneficiary missing,
+            # flag/limit violations) voids the origin so the payer's
+            # money comes back out of escrow — never stranded pending
+            leg.void = int(code) not in _MIRROR_OK
+
+    def next_resolve_batch(self, limit: int = 32) -> List[_Leg]:
+        out = []
+        for key in sorted(self._legs):
+            leg = self._legs[key]
+            if leg.phase == "resolve" and not leg.in_flight:
+                leg.in_flight = True
+                out.append(leg)
+                if len(out) >= limit:
+                    break
+        return out
+
+    def resolve_transfers(self, legs: List[_Leg]) -> List[Transfer]:
+        return [
+            Transfer(
+                id=settlement_id(leg.src, leg.op, leg.ix, 1),
+                pending_id=leg.origin_id,
+                # amount 0 resolves the FULL pending amount (reference
+                # post/void semantics), so redelivery after a partial
+                # crash needs no amount bookkeeping
+                amount=0,
+                ledger=FEDERATION_LEDGER,
+                code=SETTLE_CODE,
+                flags=int(
+                    TransferFlags.void_pending_transfer
+                    if leg.void
+                    else TransferFlags.post_pending_transfer
+                ),
+                user_data_64=leg.op,
+                user_data_32=leg.ix,
+            )
+            for leg in legs
+        ]
+
+    def on_resolve_replies(self, legs: List[_Leg], codes: List[int]) -> None:
+        for leg, code in zip(legs, codes):
+            leg.in_flight = False
+            if leg.phase != "resolve":
+                continue
+            if int(code) not in _RESOLVE_DONE:
+                self.stats["anomalies"] += 1
+                if self._metrics:
+                    self._metrics["anomalies"].add()
+            leg.phase = "done"
+            key = "legs_voided" if leg.void else "legs_posted"
+            self.stats[key] += 1
+            if self._metrics:
+                self._metrics["voided" if leg.void else "posted"].add()
+            del self._legs[(leg.op, leg.ix)]
+        if self._metrics:
+            self._metrics["inflight"].set(len(self._legs))
+
+    def on_request_failed(self, legs: List[_Leg]) -> None:
+        """Client timeout/eviction: clear in-flight so the legs restage
+        on the next driver turn (idempotent ids make the retry safe)."""
+        for leg in legs:
+            leg.in_flight = False
+
+    # -- progress ------------------------------------------------------
+
+    def pending_count(self) -> int:
+        return len(self._legs)
+
+    def idle(self) -> bool:
+        return not self._legs
+
+    def watermark(self) -> int:
+        """Highest origin op whose staged legs have ALL resolved: the
+        durable cursor may persist up to here and no further."""
+        if not self._legs:
+            return self._last_seen_op
+        return min(op for op, _ix in self._legs) - 1

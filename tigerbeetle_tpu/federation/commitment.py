@@ -1,0 +1,380 @@
+"""Checkpoint state commitments: a chained digest over ledger fingerprints.
+
+The per-op hash-log ring (PR 8) authenticates the *request stream*; it
+says nothing about the state a replica claims to have reached. The
+commitment chain closes that gap: at every commitment boundary (op
+multiple of the configured interval) the replica folds the backend's
+state fingerprint — the same five-field surface the dual applier already
+compares at finalize — into a running u64 chain:
+
+    C_k = fold(C_{k-1}, op_k, fingerprint(op_k))
+
+The fingerprint is a pure function of committed history (content-only
+per-row hash, commutative sum — slot-order independent), so every
+replica, the native backend, the dual device twin, and the numpy oracle
+all compute bit-identical chains from the same stream. A counterparty
+that replays a region's CDC stream through its own oracle recomputes the
+chain and rejects a tampered stream or state *naming the exact
+checkpoint op* where histories diverge.
+
+All arithmetic here is plain python ints masked to 64 bits — no device,
+no numpy — so the fold is trivially portable to any consumer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Shared with models/ledger.py's _fp_rows / _fp_mix (murmur3/xxhash
+# finalizer constants). The native kernel (tb_ledger_fingerprint) and
+# the device kernel implement the identical row fold; this module only
+# *chains* their outputs, but reuses the same mixing constants so there
+# is one constant set to keep in sync across implementations.
+_FP_MUL = 0xC2B2AE3D27D4EB4F
+_FP_ADD = 0x165667B19E3779F9
+_FP_MIX1 = 0xFF51AFD7ED558CCD
+_FP_MIX2 = 0xC4CEB9FE1A85EC53
+
+_M64 = (1 << 64) - 1
+
+# The exact fingerprint surface folded into the chain, in fold order.
+# NativeLedger.fingerprint() returns extra keys (e.g. "posted"); the
+# chain uses only these five so every backend agrees on the input.
+FP_FIELDS = (
+    "accounts_fp",
+    "transfers_fp",
+    "accounts",
+    "transfers",
+    "commit_timestamp",
+)
+
+
+def _mix64(x: int) -> int:
+    x &= _M64
+    x = ((x ^ (x >> 33)) * _FP_MIX1) & _M64
+    x = ((x ^ (x >> 33)) * _FP_MIX2) & _M64
+    return x ^ (x >> 33)
+
+
+def fold_commitment(prev: int, op: int, fp: Dict[str, int]) -> int:
+    """Fold one checkpoint fingerprint into the chain.
+
+    `fp` may carry extra keys; only FP_FIELDS participate. Pure python
+    ints — callable from any consumer without the repo's device stack.
+    """
+    h = prev & _M64
+    for x in (op, *(fp[k] for k in FP_FIELDS)):
+        h = _mix64(((h ^ (int(x) & _M64)) * _FP_MUL + _FP_ADD) & _M64)
+    return h
+
+
+def fp_tuple(fp: Dict[str, int]) -> Tuple[int, ...]:
+    return tuple(int(fp[k]) & _M64 for k in FP_FIELDS)
+
+
+class CommitmentMismatch(Exception):
+    """A commitment check failed; `.op` names the divergent checkpoint."""
+
+    def __init__(self, op: int, why: str):
+        super().__init__(f"commitment mismatch at checkpoint op={op}: {why}")
+        self.op = op
+        self.why = why
+
+
+class CommitmentLog:
+    """The per-replica commitment chain with a bounded entry ring.
+
+    Commitments are recorded at commit-dispatch time (state exact after
+    the boundary op applies) and are idempotent: a WAL-tail replay or a
+    redelivered dispatch re-records the same op, and the stored
+    fingerprint must match bit-exactly — a replica whose state groove
+    was tampered between runs raises CommitmentMismatch naming the
+    checkpoint. The ring keeps the most recent `ring` entries; the head
+    (op, commitment) pair is always retained, so chains survive
+    arbitrarily long histories and state-sync gaps (the snapshot source
+    records every boundary up to its checkpoint, so a restored head is
+    always the last boundary before commit_min).
+    """
+
+    def __init__(self, interval: int, ring: int = 256):
+        if interval <= 0:
+            raise ValueError("commitment interval must be positive")
+        self.interval = int(interval)
+        self.ring = int(ring)
+        self.head_op = 0
+        self.head = 0  # chain value at head_op (0 == genesis)
+        # op -> (commitment, prev, fp_tuple), ascending op order
+        self._entries: Dict[int, Tuple[int, int, Tuple[int, ...]]] = {}
+
+    # -- recording -----------------------------------------------------
+
+    def is_boundary(self, op: int) -> bool:
+        return op > 0 and op % self.interval == 0
+
+    def record(self, op: int, fp: Dict[str, int]) -> Optional[int]:
+        """Record (or idempotently re-verify) the checkpoint at `op`."""
+        t = fp_tuple(fp)
+        if op <= self.head_op:
+            ent = self._entries.get(op)
+            if ent is None:
+                return None  # older than the ring: blind, accept
+            if ent[2] != t:
+                raise CommitmentMismatch(
+                    op, f"re-recorded fingerprint {t} != stored {ent[2]}"
+                )
+            return ent[0]
+        if op != self.head_op + self.interval:
+            raise CommitmentMismatch(
+                op,
+                f"non-contiguous boundary (head={self.head_op}, "
+                f"interval={self.interval})",
+            )
+        c = fold_commitment(self.head, op, fp)
+        self._entries[op] = (c, self.head, t)
+        self.head_op = op
+        self.head = c
+        if len(self._entries) > self.ring:
+            for old in sorted(self._entries):
+                if len(self._entries) <= self.ring:
+                    break
+                del self._entries[old]
+        return c
+
+    # -- queries -------------------------------------------------------
+
+    def get(self, op: int) -> Optional[Tuple[int, int]]:
+        """(commitment, prev) at `op`, or None if outside the ring."""
+        ent = self._entries.get(op)
+        return None if ent is None else (ent[0], ent[1])
+
+    def fingerprint_at(self, op: int) -> Optional[Dict[str, int]]:
+        ent = self._entries.get(op)
+        if ent is None:
+            return None
+        return dict(zip(FP_FIELDS, ent[2]))
+
+    def ops(self) -> List[int]:
+        return sorted(self._entries)
+
+    def first_divergence(self, other: "CommitmentLog") -> Optional[int]:
+        """First overlapping checkpoint op where two chains disagree."""
+        shared = sorted(set(self._entries) & set(other._entries))
+        for op in shared:
+            if self._entries[op][0] != other._entries[op][0]:
+                return op
+        if (
+            not shared
+            and self.head_op
+            and self.head_op == other.head_op
+            and self.head != other.head
+        ):
+            return self.head_op
+        return None
+
+    def stats_snapshot(self, limit: int = 16) -> Dict[str, object]:
+        """Trimmed view for the [stats] snapshot / `inspect commitments
+        --live`: the chain head plus the most recent `limit` checkpoints
+        as [op, commitment, prev] rows."""
+        ops = sorted(self._entries)[-limit:]
+        return {
+            "interval": self.interval,
+            "head_op": self.head_op,
+            "head": self.head,
+            "recent": [
+                [op, self._entries[op][0], self._entries[op][1]] for op in ops
+            ],
+        }
+
+    # -- persistence (checkpoint extra_meta; JSON-safe ints) -----------
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "interval": self.interval,
+            "head_op": self.head_op,
+            "head": self.head,
+            "entries": [
+                [op, c, prev, list(t)]
+                for op, (c, prev, t) in sorted(self._entries.items())
+            ],
+        }
+
+    def restore(self, data: Optional[Dict[str, object]]) -> None:
+        if not data:
+            return
+        self.interval = int(data["interval"])
+        self.head_op = int(data["head_op"])
+        self.head = int(data["head"])
+        self._entries = {
+            int(op): (int(c), int(prev), tuple(int(x) for x in t))
+            for op, c, prev, t in data["entries"]
+        }
+
+
+class StreamVerifier:
+    """The external consumer: replay a CDC stream, re-derive the chain.
+
+    Feeds every record line of a region's CDC stream (from op 1 — an
+    AOF-backed tail never gaps) through a fresh numpy oracle, re-executes
+    each committed batch, cross-checks recorded per-event results, and at
+    every `commitment` record recomputes the chain from the oracle's own
+    fingerprint. A stream whose history was tampered — an edited amount,
+    a dropped event, a forged commitment — fails at the exact checkpoint
+    where the recomputed chain first disagrees.
+
+    Sans-IO: call `feed(record_dict)` per parsed JSON record (or
+    `feed_lines` for raw JSONL) and read `.report()`.
+    """
+
+    def __init__(self, strict_results: bool = True):
+        # Local import: federation must stay importable without pulling
+        # the device stack until a verifier is actually constructed.
+        from tigerbeetle_tpu.models.oracle import OracleStateMachine
+
+        self.oracle = OracleStateMachine()
+        self.strict_results = bool(strict_results)
+        self.head = 0
+        self.head_op = 0
+        self.checked = 0
+        self.ops_replayed = 0
+        self.first_divergent: Optional[int] = None
+        self.error: Optional[str] = None
+        self.gapped = False
+        self._batch: List[dict] = []
+
+    # -- feeding -------------------------------------------------------
+
+    def feed_lines(self, lines: Iterable[str]) -> None:
+        import json
+
+        for line in lines:
+            line = line.strip()
+            if line:
+                self.feed(json.loads(line))
+
+    def feed(self, rec: dict) -> None:
+        if self.error is not None:
+            return
+        kind = rec.get("kind")
+        if kind == "gap":
+            self._flush_batch()
+            self.gapped = True
+            self.error = (
+                f"stream gap {rec.get('from')}..{rec.get('to')}: "
+                "history unverifiable from here"
+            )
+            return
+        if kind == "commitment":
+            self._flush_batch()
+            self._check_commitment(rec)
+            return
+        if kind not in ("account", "transfer"):
+            return
+        if self._batch and self._batch[-1]["op"] != rec["op"]:
+            self._flush_batch()
+        self._batch.append(rec)
+
+    # -- replay --------------------------------------------------------
+
+    def _flush_batch(self) -> None:
+        if not self._batch:
+            return
+        recs, self._batch = self._batch, []
+        from tigerbeetle_tpu.types import Account, Operation, Transfer
+
+        kind = recs[0]["kind"]
+        op = recs[0]["op"]
+        timestamp = recs[-1]["ts"]
+        if kind == "account":
+            operation = Operation.create_accounts
+            events = [
+                Account(
+                    id=r["id"],
+                    ledger=r["ledger"],
+                    code=r["code"],
+                    flags=r["flags"],
+                    user_data_128=r.get("user_data_128", 0),
+                    user_data_64=r.get("user_data_64", 0),
+                    user_data_32=r.get("user_data_32", 0),
+                    # nonzero only on INVALID creates — carried so the
+                    # replay reproduces the validation result codes
+                    debits_pending=r.get("debits_pending", 0),
+                    debits_posted=r.get("debits_posted", 0),
+                    credits_pending=r.get("credits_pending", 0),
+                    credits_posted=r.get("credits_posted", 0),
+                    reserved=r.get("reserved", 0),
+                )
+                for r in recs
+            ]
+        else:
+            operation = Operation.create_transfers
+            events = [
+                Transfer(
+                    id=r["id"],
+                    debit_account_id=r["debit_account_id"],
+                    credit_account_id=r["credit_account_id"],
+                    amount=r["amount"],
+                    pending_id=r.get("pending_id", 0),
+                    timeout=r.get("timeout", 0),
+                    ledger=r["ledger"],
+                    code=r["code"],
+                    flags=r["flags"],
+                    user_data_128=r.get("user_data_128", 0),
+                    user_data_64=r.get("user_data_64", 0),
+                    user_data_32=r.get("user_data_32", 0),
+                )
+                for r in recs
+            ]
+        results = self.oracle.execute_dense(operation, timestamp, events)
+        self.ops_replayed += 1
+        if not self.strict_results:
+            return
+        for r, got in zip(recs, results):
+            want = r.get("result")
+            if want is not None and int(got) != int(want):
+                self.error = (
+                    f"op={op} ix={r['ix']}: replay result {int(got)} != "
+                    f"recorded {int(want)}"
+                )
+                return
+
+    def _check_commitment(self, rec: dict) -> None:
+        op = int(rec["op"])
+        claimed = int(rec["commitment"])
+        claimed_prev = int(rec.get("prev", self.head))
+        if claimed_prev != self.head:
+            self.first_divergent = op
+            self.error = (
+                f"checkpoint op={op}: chain prev {claimed_prev:#x} != "
+                f"replayed head {self.head:#x}"
+            )
+            return
+        fp = self.oracle.fingerprint()
+        c = fold_commitment(self.head, op, fp)
+        if c != claimed:
+            self.first_divergent = op
+            self.error = (
+                f"checkpoint op={op}: recomputed commitment {c:#x} != "
+                f"claimed {claimed:#x} (state/stream tampered at or "
+                f"before this checkpoint)"
+            )
+            return
+        self.head = c
+        self.head_op = op
+        self.checked += 1
+
+    # -- results -------------------------------------------------------
+
+    def finish(self) -> None:
+        self._flush_batch()
+
+    def report(self) -> Dict[str, object]:
+        self.finish()
+        return {
+            "ok": self.error is None,
+            "checked": self.checked,
+            "head_op": self.head_op,
+            "head": self.head,
+            "ops_replayed": self.ops_replayed,
+            "first_divergent": self.first_divergent,
+            "error": self.error,
+        }

@@ -1,0 +1,215 @@
+"""Declarative federation topology: regions, routing, account derivation.
+
+Ownership is by id hash: an untagged account id belongs to
+`region_of(id)`. The federation's own infrastructure accounts are tagged
+in the top byte of the 128-bit id space (ids clients never mint — the
+workload generator's odd golden-ratio ids and real client ids land in
+the untagged space):
+
+    0xAC  home (user) account pinned to a region (salt rejection-sampled
+          until the owner hash agrees with the pin)
+    0xE5  escrow account for pair (src -> dst), lives on src
+    0xA1  mirror account for pair (dst <- src), lives on dst
+    0xC0  origin pending-transfer ids minted by an issuer on src
+    0x5E  settlement-leg transfer ids minted by the agent (deterministic
+          per (src, op, ix, leg) — the REMOTE ledger is the dedup
+          authority: a redelivered leg hits `exists`, which counts as
+          success)
+
+Cross-region money flow for A -> B of `amount`:
+
+    on A: pending  debit=payer,        credit=escrow(A->B)   [origin]
+    on B: posted   debit=mirror(B<-A), credit=beneficiary    [leg 0]
+    on A: post_pending of the origin (or void on terminal failure)
+                                                             [leg 1]
+
+Conservation invariant (checked by SimFederation and the chaos
+harness): escrow(A->B).credits_posted on A == mirror(B<-A).debits_posted
+on B, and escrow credits_pending drains to zero once settlement
+quiesces — zero lost, zero duplicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from tigerbeetle_tpu.federation.commitment import _mix64
+
+# All federation traffic lives on its own ledger: settlement legs can
+# never collide with workload ledgers, and per-ledger conservation
+# (oracle.verify_conservation) applies to the federation flow alone.
+FEDERATION_LEDGER = 0xFED
+SETTLE_CODE = 0x5E7
+
+_M64 = (1 << 64) - 1
+U128_MAX = (1 << 128) - 1
+
+TAG_SHIFT = 120
+TAG_HOME = 0xAC
+TAG_ESCROW = 0xE5
+TAG_MIRROR = 0xA1
+TAG_ORIGIN = 0xC0
+TAG_SETTLE = 0x5E
+
+MAX_REGIONS = 16  # settlement ids carry the region in a 4-bit field
+
+
+def tag_of(account_id: int) -> int:
+    return (account_id >> TAG_SHIFT) & 0xFF
+
+
+def region_of(account_id: int, n_regions: int) -> int:
+    """Owner region of an UNTAGGED id (64-bit-folded hash mod N)."""
+    return _mix64((account_id & _M64) ^ (account_id >> 64)) % n_regions
+
+
+def escrow_account_id(src: int, dst: int) -> int:
+    """The (src -> dst) escrow, held on src: origin pendings credit it;
+    posting the origin moves the money into it for good."""
+    return (TAG_ESCROW << TAG_SHIFT) | (src << 112) | (dst << 104)
+
+
+def mirror_account_id(dst: int, src: int) -> int:
+    """The (dst <- src) mirror, held on dst: settlement legs debit it —
+    it is src's liability column on dst's books."""
+    return (TAG_MIRROR << TAG_SHIFT) | (dst << 112) | (src << 104)
+
+
+def escrow_pair(account_id: int) -> Tuple[int, int]:
+    return (account_id >> 112) & 0xFF, (account_id >> 104) & 0xFF
+
+
+def settlement_id(src: int, op: int, ix: int, leg: int) -> int:
+    """Deterministic settlement-leg transfer id for origin event
+    (src region, committed op, event index). leg 0 = the mirror transfer
+    on dst; leg 1 = the post/void of the origin pending on src. Pure
+    function of the committed origin stream -> idempotent across agent
+    crash/redelivery."""
+    return (
+        (TAG_SETTLE << TAG_SHIFT)
+        | ((src & 0xF) << 116)
+        | ((leg & 0xF) << 112)
+        | ((op & ((1 << 80) - 1)) << 24)
+        | (ix & 0xFFFFFF)
+    )
+
+
+def origin_id(src: int, seq: int) -> int:
+    """Origin pending-transfer id minted by an issuer on src."""
+    return (TAG_ORIGIN << TAG_SHIFT) | ((src & 0xFF) << 112) | (seq & ((1 << 112) - 1))
+
+
+def home_account_id(region: int, k: int, n_regions: int) -> int:
+    """The k-th user account pinned to `region`: tagged base + the
+    smallest salt whose owner hash lands on the region (expected
+    n_regions tries; deterministic — every replica and the sim twin
+    derive the same id)."""
+    base = (TAG_HOME << TAG_SHIFT) | ((region & 0xFF) << 112) | ((k & _M64) << 32)
+    for salt in range(1 << 20):
+        cand = base | salt
+        if region_of(cand, n_regions) == region:
+            return cand
+    raise AssertionError("unreachable: owner hash never landed")
+
+
+@dataclasses.dataclass
+class RegionSpec:
+    """One region of the federation. `addresses` is the live mode's
+    replica address list (host:port per replica); sim regions leave it
+    empty and carry only the name/index."""
+
+    index: int
+    name: str = ""
+    addresses: tuple = ()
+    data_dir: Optional[str] = None
+
+    def __post_init__(self):
+        if not self.name:
+            self.name = f"r{self.index}"
+
+
+class FederationTopology:
+    """The declarative N-region map every federation component shares:
+    the settlement agent routes by it, the sim builds clusters from it,
+    the live driver spawns processes from it."""
+
+    def __init__(self, regions: List[RegionSpec]):
+        assert 2 <= len(regions) <= MAX_REGIONS, len(regions)
+        assert [r.index for r in regions] == list(range(len(regions)))
+        self.regions = regions
+
+    @property
+    def n(self) -> int:
+        return len(self.regions)
+
+    @classmethod
+    def of(cls, n_regions: int) -> "FederationTopology":
+        return cls([RegionSpec(index=i) for i in range(n_regions)])
+
+    def region_of(self, account_id: int) -> int:
+        """Owner region of any account id, tagged or not."""
+        tag = tag_of(account_id)
+        if tag == TAG_ESCROW:
+            return escrow_pair(account_id)[0]
+        if tag == TAG_MIRROR:
+            return escrow_pair(account_id)[0]
+        if tag == TAG_HOME:
+            return (account_id >> 112) & 0xFF
+        return region_of(account_id, self.n)
+
+    def escrow(self, src: int, dst: int) -> int:
+        assert src != dst
+        return escrow_account_id(src, dst)
+
+    def mirror(self, dst: int, src: int) -> int:
+        assert src != dst
+        return mirror_account_id(dst, src)
+
+    def infra_account_ids(self, region: int) -> List[int]:
+        """Every escrow/mirror account `region` must hold (one per remote
+        peer, each direction) — created once at federation bootstrap."""
+        out = []
+        for other in range(self.n):
+            if other == region:
+                continue
+            out.append(self.escrow(region, other))
+            out.append(self.mirror(region, other))
+        return out
+
+    # -- stream classification (the agent's routing predicate) ---------
+
+    def classify_outbound(self, rec: dict, region: int) -> Optional[dict]:
+        """Is this committed change record an origin pending leaving
+        `region`? Returns {dst, beneficiary, amount} or None. Matches
+        only SUCCESSFUL two-phase pendings on the federation ledger that
+        credit one of this region's outbound escrows; settlement legs
+        the agent itself writes never match (mirror legs are plain
+        posted transfers, resolve legs carry post/void flags)."""
+        from tigerbeetle_tpu.types import TransferFlags
+
+        if rec.get("kind") != "transfer" or rec.get("result") != 0:
+            return None
+        if rec.get("ledger") != FEDERATION_LEDGER:
+            return None
+        if rec.get("code") != SETTLE_CODE:
+            return None
+        flags = int(rec.get("flags", 0))
+        if not flags & int(TransferFlags.pending):
+            return None
+        if flags & (
+            int(TransferFlags.post_pending_transfer)
+            | int(TransferFlags.void_pending_transfer)
+        ):
+            return None
+        credit = int(rec["credit_account_id"])
+        if tag_of(credit) != TAG_ESCROW:
+            return None
+        src, dst = escrow_pair(credit)
+        if src != region or dst == region:
+            return None
+        return {
+            "dst": dst,
+            "beneficiary": int(rec.get("user_data_128", 0)),
+            "amount": int(rec["amount"]),
+        }

@@ -39,12 +39,13 @@ class CdcFanoutHub:
         self._g_lag = m.gauge("ingress.fanout_lag_ops")
 
     def add_consumer(self, name: str, sink, cursor,
-                     ack_interval: int = 32) -> CdcPump:
+                     ack_interval: int = 32,
+                     commitments: bool = False) -> CdcPump:
         assert name not in self.pumps, f"duplicate consumer {name!r}"
         pump = CdcPump(
             self.replica, sink, cursor,
             window=self.tail.window, ack_interval=ack_interval,
-            tail=self.tail,
+            tail=self.tail, commitments=commitments,
         )
         self.pumps[name] = pump
         self._g_consumers.set(len(self.pumps))

@@ -539,6 +539,121 @@ def inspect_lsm(storage: Storage, cluster: ConfigCluster,
 
 
 # ----------------------------------------------------------------------
+# checkpoint state commitments (federation/commitment.py)
+# ----------------------------------------------------------------------
+
+
+def inspect_commitments_offline(storage: Storage) -> dict:
+    """Decode the checkpointed commitment chain from the data file's
+    superblock meta (written by Replica._checkpoint when the server runs
+    with --commitment-interval). Offline truth: what the LAST checkpoint
+    durably published — the live chain may be ahead by up to one
+    checkpoint interval of WAL tail."""
+    state = _open_state(storage)
+    data = state.meta.get("commitments") if state is not None else None
+    if not data:
+        return {
+            "enabled": False,
+            "note": "no commitment chain in the checkpoint meta "
+                    "(server not started with --commitment-interval, or "
+                    "no checkpoint has run yet)",
+        }
+    return {
+        "enabled": True,
+        "interval": int(data["interval"]),
+        "head_op": int(data["head_op"]),
+        "head": int(data["head"]),
+        "checkpoints": [
+            [int(op), int(c), int(prev)]
+            for op, c, prev, _t in data["entries"]
+        ],
+    }
+
+
+def commitments_from_stats(stats: dict) -> dict:
+    """The live chain out of a [stats] registry snapshot (inspect_live /
+    cmd_start's _on_term line both carry the same key)."""
+    snap = stats.get("commitments")
+    if not snap:
+        return {
+            "enabled": False,
+            "note": "server has no commitment chain "
+                    "(start with --commitment-interval N)",
+        }
+    return {
+        "enabled": True,
+        "interval": int(snap["interval"]),
+        "head_op": int(snap["head_op"]),
+        "head": int(snap["head"]),
+        "checkpoints": [
+            [int(op), int(c), int(prev)] for op, c, prev in snap["recent"]
+        ],
+    }
+
+
+def verify_commitment_stream(path: str) -> dict:
+    """External-consumer verification of a region's CDC stream: replay
+    every change record through a fresh oracle and re-derive the
+    commitment chain at every `commitment` record. The stream must start
+    at op 1 (an AOF-backed tail never gaps). A tampered stream or a
+    forged commitment fails AT the divergent checkpoint, named in the
+    report — this is the trust boundary a settlement counterparty
+    checks before accepting a region's stream.
+
+    The JSONL file has at-least-once framing: a crashed streamer resumes
+    from its durable cursor (duplicate op groups) and a SIGKILL mid-write
+    tears a tail line that the next incarnation's append glues onto.
+    Committed history never changes, so dedup is first-wins per record
+    identity — (op, ix) for events, op for commitment records — and
+    unparseable glue lines are skipped and counted (their ops arrive
+    again intact with the redelivery)."""
+    from tigerbeetle_tpu.federation.commitment import StreamVerifier
+
+    events: dict = {}       # op -> {ix: record}
+    commitments: dict = {}  # op -> record
+    gaps: dict = {}         # start op -> record
+    torn = redelivered = 0
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                torn += 1
+                continue
+            kind = rec.get("kind")
+            if kind == "gap":
+                gaps.setdefault(int(rec["from"]), rec)
+            elif kind == "commitment":
+                if int(rec["op"]) in commitments:
+                    redelivered += 1
+                else:
+                    commitments[int(rec["op"])] = rec
+            elif kind in ("account", "transfer"):
+                group = events.setdefault(int(rec["op"]), {})
+                if int(rec.get("ix", 0)) in group:
+                    redelivered += 1
+                else:
+                    group[int(rec.get("ix", 0))] = rec
+    v = StreamVerifier()
+    for op in sorted(set(events) | set(commitments) | set(gaps)):
+        if op in gaps:
+            v.feed(gaps[op])
+        group = events.get(op, {})
+        for ix in sorted(group):
+            v.feed(group[ix])
+        if op in commitments:
+            v.feed(commitments[op])
+    report = v.report()
+    report["stream"] = path
+    report["torn_lines"] = torn
+    report["redelivered_records"] = redelivered
+    return report
+
+
+# ----------------------------------------------------------------------
 # live mode
 # ----------------------------------------------------------------------
 
@@ -881,6 +996,35 @@ def render(topic: str, report: dict, out) -> None:
                 f"client {e['client']}: session {e['session']} request "
                 f"{e['request']} slot {e['slot']}\n"
             )
+    elif topic == "commitments":
+        if "ok" in report:  # stream-verify mode
+            verdict = "VERIFIED" if report["ok"] else "REJECTED"
+            out.write(
+                f"{verdict}: {report.get('stream', '')} — "
+                f"{report['checked']} checkpoint(s), "
+                f"{report['ops_replayed']} op(s) replayed\n"
+            )
+            if report.get("head_op"):
+                out.write(
+                    f"chain head: op {report['head_op']} = "
+                    f"{report['head']:#018x}\n"
+                )
+            if report.get("first_divergent") is not None:
+                out.write(
+                    f"FIRST DIVERGENT CHECKPOINT: op "
+                    f"{report['first_divergent']}\n"
+                )
+            if report.get("error"):
+                out.write(f"error: {report['error']}\n")
+        elif not report.get("enabled"):
+            out.write(f"commitments disabled: {report.get('note', '')}\n")
+        else:
+            out.write(
+                f"interval {report['interval']}, chain head: op "
+                f"{report['head_op']} = {report['head']:#018x}\n"
+            )
+            for op, c, prev in report.get("checkpoints", ()):
+                out.write(f"op {op}: {c:#018x} (prev {prev:#018x})\n")
     else:  # wal-op dumps, live snapshots, anything structured
         json.dump(report, out, indent=1, sort_keys=True)
         out.write("\n")

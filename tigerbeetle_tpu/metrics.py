@@ -597,6 +597,31 @@ CATALOG = {
     "cdc.resume_forks": ("counter", "", "cursor checksum mismatches detected at resume"),
     "cdc.cursor_writes": ("counter", "", "durable cursor acks (atomic write-rename)"),
     "cdc.pump_us": ("histogram", "us", "one bounded pump turn (encode + emit)"),
+    "cdc.commitment_records": (
+        "counter", "records", "checkpoint state-commitment records emitted"
+    ),
+    # cross-ledger federation (tigerbeetle_tpu/federation): the
+    # settlement agent's per-region counters — at-least-once delivery
+    # means the leg counters can exceed unique-event counts across agent
+    # crash/redelivery (the conservation check is the authority)
+    "federation.inflight_legs": (
+        "gauge", "legs", "settlement legs staged and unresolved in the agent window"
+    ),
+    "federation.outbound_seen": (
+        "counter", "legs", "outbound origin pendings recognized in the stream"
+    ),
+    "federation.legs_posted": (
+        "counter", "legs", "origin pendings settled (mirror leg ok, origin posted)"
+    ),
+    "federation.legs_voided": (
+        "counter", "legs", "origin pendings voided (mirror leg terminally rejected)"
+    ),
+    "federation.sink_refusals": (
+        "counter", "", "ops refused at the agent window (pump retries them)"
+    ),
+    "federation.anomalies": (
+        "counter", "legs", "resolve replies outside the expected code family"
+    ),
     # ingress gateway + bus front door (tigerbeetle_tpu/ingress)
     "ingress.sessions": ("gauge", "sessions", "live logical sessions in the gateway table"),
     "ingress.admitted": ("counter", "requests", "requests admitted by the credit regulator"),

@@ -74,6 +74,7 @@ from tigerbeetle_tpu.types import Operation
 
 _STOP = object()
 _INSTALL = "__install__"  # control item: re-seed the device from a snapshot
+_PROBE = "__probe__"  # control item: checkpoint-commitment fingerprint probe
 
 # Rolling per-op digest ring (follower mode): one chained-fold value per
 # committed create op, op % RING. 4096 ops cover well over a full WAL ring
@@ -320,6 +321,11 @@ class DualLedger:
         # tests): set before traffic flows, read by the apply thread
         self._test_corrupt_apply_op: int | None = None  # vet: handoff
         self._test_apply_delay_s = 0.0  # vet: handoff
+        # commitment probes (federation/commitment.py): (op, host
+        # fingerprint, LAZY device fingerprint) per checkpoint boundary,
+        # appended by the apply thread, materialized + compared at
+        # finalize (join-before-read)
+        self._probe_out: list = []  # vet: handoff
         # loop cost accounting (the h2d/staging tax shares the core
         # with the reply-serving event loop): stage_s = host time spent
         # staging + dispatching apply work; idle_s = blocked on an empty
@@ -574,6 +580,11 @@ class DualLedger:
                         )
                     except Exception as e:
                         self._shadow_error = e
+                elif kind == _PROBE:
+                    try:
+                        self._apply_probe(run[0][1], run[0][2])
+                    except Exception as e:
+                        self._shadow_error = e
                 self._consumed_seq += 1
                 with self._apply_cond:
                     self._apply_cond.notify_all()
@@ -826,6 +837,13 @@ class DualLedger:
                         )
                     except Exception as e:
                         self._shadow_error = e
+                elif deferred_control[0] == _PROBE:
+                    try:
+                        self._apply_probe(
+                            deferred_control[1], deferred_control[2]
+                        )
+                    except Exception as e:
+                        self._shadow_error = e
                 self._consumed_seq += 1
             with self._apply_cond:
                 self._apply_cond.notify_all()
@@ -892,6 +910,47 @@ class DualLedger:
             self._op_ring[i] = None
         return fresh_chains
 
+    def _apply_probe(self, op: int, fp_host: dict) -> None:
+        """Handle a _PROBE control item ON the apply thread: stash the
+        DEVICE state fingerprint at a checkpoint-commitment boundary.
+        The probe item was enqueued at the boundary op's commit finalize
+        — finalizes run in op order, so every create <= op is already in
+        the queue ahead of it and none after it — which makes the lazy
+        fingerprint exact for the boundary. Dispatch-only (no d2h): the
+        scalars materialize at finalize() alongside the digest rings."""
+        if self._restored:
+            return
+        self._probe_out.append((op, fp_host, self.device.fingerprint_lazy()))
+
+    def _commitment_probe_check(self) -> dict:
+        """Materialize the probed device fingerprints (finalize-time d2h,
+        a handful of scalars per checkpoint) and compare each against the
+        host engine's fingerprint recorded in the commitment chain —
+        names the FIRST checkpoint where the device twin's state diverged
+        from the committed history."""
+        from tigerbeetle_tpu.federation.commitment import FP_FIELDS
+
+        first = None
+        detail = {}
+        for op, fp_host, fp_dev_lazy in self._probe_out:
+            fp_dev = {k: int(np.asarray(v)) for k, v in fp_dev_lazy.items()}
+            for k in FP_FIELDS:
+                if int(fp_host[k]) != int(fp_dev[k]):
+                    if first is None:
+                        first = op
+                        detail = {
+                            "field": k,
+                            "host": int(fp_host[k]),
+                            "device": int(fp_dev[k]),
+                        }
+                    break
+        return {
+            "checked": len(self._probe_out),
+            "ok": first is None,
+            "first_divergent_op": first,
+            **detail,
+        }
+
     # -- follower apply seam (driven by the replica at commit finalize) ----
 
     def apply_commit(
@@ -926,6 +985,17 @@ class DualLedger:
             (op, operation, timestamp, arr, codes, prepare_checksum,
              trace, lat_ns)
         )
+
+    def commitment_probe(self, op: int, fp_host: dict) -> None:
+        """Enqueue a checkpoint-commitment fingerprint probe (follower
+        mode): called by the replica at the boundary op's commit
+        finalize with the HOST engine's fingerprint from the commitment
+        chain. The apply thread stashes the device twin's lazy
+        fingerprint at the matching point in its queue; finalize()
+        compares them per checkpoint."""
+        assert self.follower
+        self._put_seq += 1
+        self._q.put((_PROBE, op, fp_host))
 
     # -- XLA trace bridge (--device-trace) ---------------------------------
 
@@ -1106,6 +1176,12 @@ class DualLedger:
     def commit_timestamp(self) -> int:
         return self.native.commit_timestamp
 
+    def fingerprint(self) -> dict:
+        """The host engine's state digest (commitment chain input). The
+        device twin's fingerprint is compared per checkpoint at
+        finalize() via the commitment_probe seam."""
+        return self.native.fingerprint()
+
     def snapshot_bytes(self) -> bytes:
         return self.native.snapshot_bytes()
 
@@ -1245,6 +1321,10 @@ class DualLedger:
         if self.follower and self._dev_ring_out is not None:
             report["hash_log"] = self._hash_ring_check()
             if not report["hash_log"]["ok"]:
+                report["verified"] = False
+        if self._probe_out:
+            report["commitments"] = self._commitment_probe_check()
+            if not report["commitments"]["ok"]:
                 report["verified"] = False
         return report
 

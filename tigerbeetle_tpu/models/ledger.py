@@ -395,6 +395,34 @@ def fold_reply_codes_np(chk: int, codes: np.ndarray) -> int:
         return int(out)
 
 
+def fp_rows_np(rows: np.ndarray) -> tuple:
+    """The numpy twin of _fp_rows over 128-byte wire rows (structured
+    ACCOUNT_DTYPE/TRANSFER_DTYPE arrays or raw [n, 32]-u32). The per-row
+    hash is content-only and the reduction a commutative sum, so the
+    oracle computes the same digest from its dict-ordered wire images as
+    the device does from its open-addressed slots — this is what lets an
+    external CDC consumer recompute checkpoint commitments."""
+    if rows.dtype != np.uint32:
+        rows = np.ascontiguousarray(rows).view(np.uint32)
+    rows = rows.reshape(-1, ROW_WORDS)
+    if len(rows) == 0:
+        return 0, 0
+    with np.errstate(over="ignore"):
+        h = np.full(rows.shape[0], _FP_SEED, dtype=np.uint64)
+        for i in range(ROW_WORDS):
+            h = h ^ (rows[:, i].astype(np.uint64) * _FP_MUL)
+            h = ((h << np.uint64(27)) | (h >> np.uint64(37))) * _FP_SEED + _FP_ADD
+        h = (h ^ (h >> np.uint64(33))) * _FP_MIX1
+        h = (h ^ (h >> np.uint64(33))) * _FP_MIX2
+        h = h ^ (h >> np.uint64(33))
+        k4 = rows[:, :4]
+        live = ~(k4 == 0).all(axis=1) & ~(k4 == 0xFFFFFFFF).all(axis=1)
+        return (
+            int(np.sum(np.where(live, h, np.uint64(0)), dtype=np.uint64)),
+            int(np.sum(live, dtype=np.uint64)),
+        )
+
+
 # ----------------------------------------------------------------------
 # wire-row pack/unpack (word offsets = byte offsets / 4 of the extern
 # structs, reference: src/tigerbeetle.zig:7-40 Account, :64-89 Transfer)
@@ -2492,14 +2520,19 @@ class DeviceLedger(HostLedgerBase):
             for i, (_ts, arr) in enumerate(items)
         ]
 
-    def fingerprint(self) -> dict:
-        """Materialized state_fingerprint (ONE scalar-only d2h — the dual
-        server calls this once, after its clock stops)."""
+    def fingerprint_lazy(self) -> dict:
+        """state_fingerprint as DEVICE scalars (dispatch only, no d2h):
+        the dual applier's commitment probe stashes these at each
+        checkpoint boundary and materializes them once, at finalize."""
         fn = getattr(self, "_fingerprint_cache", None)
         if fn is None:
             fn = self._fingerprint_cache = sentinel_jit("fingerprint", state_fingerprint)
-        out = fn(self.state)
-        return {k: int(np.asarray(v)) for k, v in out.items()}
+        return fn(self.state)
+
+    def fingerprint(self) -> dict:
+        """Materialized state_fingerprint (ONE scalar-only d2h — the dual
+        server calls this once, after its clock stops)."""
+        return {k: int(np.asarray(v)) for k, v in self.fingerprint_lazy().items()}
 
     def check_fault(self) -> None:
         """Raise if the device hit the fault protocol (see module docstring).

@@ -260,6 +260,27 @@ class OracleStateMachine:
             backend.commit_timestamp, self.commit_timestamp,
         )
 
+    def fingerprint(self) -> dict:
+        """Order-independent state digest matching DeviceLedger /
+        NativeLedger fingerprint() bit-exactly (models/ledger.py
+        fp_rows_np): the commutative per-row sum makes the dict-ordered
+        wire images hash identically to the device's slot layout. This
+        is what lets StreamVerifier recompute a region's checkpoint
+        commitments from its CDC stream alone."""
+        from tigerbeetle_tpu.models.ledger import fp_rows_np
+        from tigerbeetle_tpu.types import accounts_to_np, transfers_to_np
+
+        afp, alive = fp_rows_np(accounts_to_np(list(self.accounts.values())))
+        tfp, tlive = fp_rows_np(transfers_to_np(list(self.transfers.values())))
+        assert alive == len(self.accounts) and tlive == len(self.transfers)
+        return {
+            "accounts_fp": afp,
+            "transfers_fp": tfp,
+            "accounts": alive,
+            "transfers": tlive,
+            "commit_timestamp": self.commit_timestamp,
+        }
+
     def snapshot_bytes(self) -> bytes:
         import json
 

@@ -424,6 +424,8 @@ class Simulator:
         primary_crash_probability: float = 0.0,
         latency_sample_every: int = 0,
         tick_hook=None,
+        commitment_interval: int = 0,
+        tail_aof: bool = False,
     ):
         from tigerbeetle_tpu.constants import TEST_PROCESS
 
@@ -433,11 +435,14 @@ class Simulator:
         # first committed op, or a consumer resuming across a tailed-
         # replica restart reads the WAL with the reply ring empty and
         # streams result:null records
-        self.cdc_enabled = cdc_consumer or cdc_fanout > 0
+        self.cdc_enabled = cdc_consumer or cdc_fanout > 0 or tail_aof
         # Fan-out mode's AOF (see the cdc_fanout block below) — created
         # BEFORE the replica loop so replica 0 appends from op 1.
+        # `tail_aof` forces it without fan-out consumers: an external
+        # harness (SimFederation's settlement agent) tailing replica 0
+        # needs the deep-resume source so its stream never gaps.
         self._fanout_aof = None
-        if cdc_fanout:
+        if cdc_fanout or tail_aof:
             import tempfile
 
             self._fanout_aof = tempfile.NamedTemporaryFile(
@@ -466,6 +471,13 @@ class Simulator:
         # committed history byte-identical AND fold identical latency
         # histograms across runs of one seed (tests/test_latency.py).
         self.latency_sample_every = latency_sample_every
+        # Checkpoint state commitments (federation/commitment.py): every
+        # replica carries a CommitmentLog folding the backend fingerprint
+        # at op multiples of the interval; _check re-derives the chain
+        # from the god's-eye history through the oracle and compares
+        # every replica's ring — set before the replica loop so rebuilt
+        # replicas get their log back too.
+        self.commitment_interval = commitment_interval
         self.seed = seed
         self.rng = random.Random(seed)
         self.ticks_budget = ticks
@@ -682,6 +694,13 @@ class Simulator:
             from tigerbeetle_tpu.aof import AOF
 
             r.aof = AOF(self._fanout_aof.name)
+        if self.commitment_interval:
+            from tigerbeetle_tpu.federation.commitment import CommitmentLog
+
+            # before open(): the restart path restores the persisted
+            # chain from checkpoint meta and the WAL-tail replay
+            # re-records against it
+            r.commitment_log = CommitmentLog(self.commitment_interval)
         # thread timing must not leak into seeded deterministic runs
         r.sync_payload_async = False
         r.open()
@@ -941,30 +960,38 @@ class Simulator:
 
     # -- main loop --
 
+    def step(self) -> None:
+        """ONE simulation tick: fault draws, replica/client/CDC ticks,
+        network delivery — the exact body `run()` repeats. Extracted so
+        a composite harness (federation/sim.py SimFederation) can
+        interleave several Simulators tick-by-tick and drive agents
+        between them without forking the loop."""
+        now = self.net.tick_now
+        if self.tick_hook is not None:
+            self.tick_hook(self, now)
+        self._maybe_crash(now)
+        self._maybe_grid_fault()
+        self._maybe_restart(now)
+        for i, r in enumerate(self.replicas):
+            if i not in self.down:
+                self.times[i].tick()
+                r.tick()
+        if self.storm_tick is not None and now >= self.storm_tick:
+            self.storm_tick = None
+            base = len(self.clients)
+            for i in range(self.storm_clients):
+                self.clients.append(self._new_sim_client(base + i))
+        for c in self.clients:
+            c.tick(now)
+        if self.cdc is not None:
+            self.cdc.tick(now)
+        if self.cdc_fanout is not None:
+            self.cdc_fanout.tick(now)
+        self.net.tick()
+
     def run(self) -> dict:
         for _ in range(self.ticks_budget):
-            now = self.net.tick_now
-            if self.tick_hook is not None:
-                self.tick_hook(self, now)
-            self._maybe_crash(now)
-            self._maybe_grid_fault()
-            self._maybe_restart(now)
-            for i, r in enumerate(self.replicas):
-                if i not in self.down:
-                    self.times[i].tick()
-                    r.tick()
-            if self.storm_tick is not None and now >= self.storm_tick:
-                self.storm_tick = None
-                base = len(self.clients)
-                for i in range(self.storm_clients):
-                    self.clients.append(self._new_sim_client(base + i))
-            for c in self.clients:
-                c.tick(now)
-            if self.cdc is not None:
-                self.cdc.tick(now)
-            if self.cdc_fanout is not None:
-                self.cdc_fanout.tick(now)
-            self.net.tick()
+            self.step()
 
         try:
             self._heal_and_converge()
@@ -1031,6 +1058,13 @@ class Simulator:
             # ops THIS RUN streamed/verified — in check mode len(entries)
             # is the preloaded recording and says nothing about coverage
             out_cdc["hash_log_ops"] = self.hash_log.ops_seen
+        if self.commitment_interval:
+            # chain head in the result dict: the vopr fleet JSONL (and
+            # its hub replay comparison) then covers commitment
+            # determinism for free
+            cl = self.replicas[0].commitment_log
+            out_cdc["commitment_head_op"] = cl.head_op
+            out_cdc["commitment_head"] = cl.head
         return {
             "seed": self.seed,
             "committed_ops": committed,
@@ -1127,19 +1161,44 @@ class Simulator:
         mins = {r.commit_min for r in self.replicas}
         assert mins == {top}, (mins, top)
 
-        # 3. oracle replay parity, bit for bit, on every replica
+        # 3. oracle replay parity, bit for bit, on every replica —
+        # folding the commitment chain at every boundary when enabled,
+        # so the god's-eye oracle derives the reference chain too
+        clog = None
+        if self.commitment_interval:
+            from tigerbeetle_tpu.federation.commitment import CommitmentLog
+
+            clog = CommitmentLog(self.commitment_interval)
         sm = StateMachine(OracleStateMachine(), self.cluster_config)
         for op in range(1, top + 1):
             _, operation, timestamp, body = merged[op]
-            if operation == int(Operation.register):
-                continue
-            sm.commit(Operation(operation), timestamp, body)
+            if operation != int(Operation.register):
+                sm.commit(Operation(operation), timestamp, body)
+            if clog is not None and clog.is_boundary(op):
+                clog.record(op, sm.backend.fingerprint())
         oracle = sm.backend
         for r in self.replicas:
             accounts, transfers, posted = r.ledger.extract()
             assert accounts == oracle.accounts, f"replica {r.replica} accounts"
             assert transfers == oracle.transfers, f"replica {r.replica} transfers"
             assert posted == oracle.posted, f"replica {r.replica} posted"
+            if clog is not None and r.commitment_log is not None:
+                # the replica's device/native-fed chain must agree with
+                # the oracle-derived reference at every overlapping
+                # checkpoint AND at the head
+                div = clog.first_divergence(r.commitment_log)
+                assert div is None, (
+                    f"replica {r.replica} commitment diverges at "
+                    f"checkpoint op {div}"
+                )
+                assert r.commitment_log.head_op == clog.head_op, (
+                    r.commitment_log.head_op, clog.head_op,
+                )
+                assert r.commitment_log.head == clog.head, (
+                    f"replica {r.replica} commitment head "
+                    f"{r.commitment_log.head:#x} != oracle {clog.head:#x} "
+                    f"at op {clog.head_op}"
+                )
 
         if self.cdc is not None:
             self.cdc.drain()

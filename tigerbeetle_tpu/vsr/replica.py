@@ -320,6 +320,12 @@ class Replica:
         # pin the gateway's sessions_max cap forever (conn close never
         # fires while other sessions keep the connection alive).
         self.ingress_evict_hook = None
+        # checkpoint state commitments (federation/commitment.py): when a
+        # CommitmentLog is installed (cli --commitment-interval, the
+        # federation harness, SimFederation), every boundary op's commit
+        # dispatch folds the backend's state fingerprint into the chain;
+        # the ring persists in checkpoint meta and ships via state sync.
+        self.commitment_log = None
         self.cdc_retain = False
         self.cdc_replies: dict[int, bytes] = {}
         # Finalized-op watermark: with an async commit window, commit_min
@@ -432,6 +438,12 @@ class Replica:
         self.checkpoint_op = state.commit_min
         self.commit_min = self.commit_max = self.op = state.commit_min
         self.cdc_commit_min = state.commit_min  # executed pre-restart
+        if self.commitment_log is not None:
+            # restore BEFORE the WAL-tail replay below: replayed boundary
+            # ops re-record against the restored head (the persisted head
+            # is the last boundary <= the checkpoint's commit_min, so the
+            # replay's boundaries extend the chain contiguously)
+            self.commitment_log.restore(state.meta.get("commitments"))
         self.parent_checksum = self.commit_checksum = state.commit_min_checksum
         recovered = self.journal.recover()
         op = state.commit_min + 1
@@ -526,6 +538,11 @@ class Replica:
             for c, e in self.client_table.items()
         }
         extra_meta = {"view": self.view, "log_view": self.log_view}
+        if self.commitment_log is not None:
+            # the chain rides checkpoint meta (and therefore state-sync
+            # shipping): a restored/synced replica resumes the chain from
+            # the last boundary at or before this checkpoint's commit_min
+            extra_meta["commitments"] = self.commitment_log.snapshot()
         extra_blobs = None
         encoded = _json.dumps(table, sort_keys=True).encode()
         if len(encoded) > CLIENT_TABLE_INLINE_MAX:
@@ -1135,6 +1152,8 @@ class Replica:
             # (latency.py top-K ring) — `inspect live` renders them
             "latency_slowest": self.latency.slowest(limit=16),
         }
+        if self.commitment_log is not None:
+            snap["commitments"] = self.commitment_log.stats_snapshot()
         da = getattr(self.ledger, "device_anatomy", None)
         if da is not None:
             ds = da.slowest(limit=8)
@@ -1788,6 +1807,14 @@ class Replica:
             ):
                 break
             run.append(e)
+            if self.commitment_log is not None and self.commitment_log.is_boundary(
+                first_op + len(run) - 1
+            ):
+                # a commitment boundary ends its fused run: the group's
+                # single device dispatch precedes every per-op
+                # _commit_dispatch, so a mid-run boundary would
+                # fingerprint state that already includes later ops
+                break
         if len(run) < 2:
             return False
         handles = self.sm.commit_group_async(
@@ -1995,6 +2022,15 @@ class Replica:
             if plan is not None and plan[0] == "waves":
                 self.group_stats.add("wave_ops")
                 self.group_stats.add("wave_dispatches", plan[1])
+        clog = self.commitment_log
+        if clog is not None and clog.is_boundary(header.op):
+            # fold the backend's state fingerprint into the commitment
+            # chain at dispatch: every op <= header.op has dispatched,
+            # none after (group runs break at boundaries). Idempotent
+            # across the stall/retry re-entry and WAL-tail replay — a
+            # re-record with a different fingerprint raises naming this
+            # checkpoint.
+            clog.record(header.op, self.sm.backend.fingerprint())
         if self.commit_hook is not None:
             self.commit_hook(header, body)
         if self.aof is not None:
@@ -2100,6 +2136,19 @@ class Replica:
                 # enqueue->upload into latency.device_apply_lag_us
                 lat_ns=perf_counter_ns() if entry.get("lt") else 0,
             )
+        if (
+            self._dual_apply
+            and self.commitment_log is not None
+            and self.commitment_log.is_boundary(header.op)
+        ):
+            # commitment probe: finalizes run in op order, so the device
+            # applier's queue holds exactly the creates <= this boundary
+            # when the probe lands — the apply thread stashes the device
+            # twin's lazy fingerprint there; finalize() compares it
+            # against the chain's host fingerprint per checkpoint.
+            fp = self.commitment_log.fingerprint_at(header.op)
+            if fp is not None and hasattr(self.ledger, "commitment_probe"):
+                self.ledger.commitment_probe(header.op, fp)
         self.cdc_commit_min = header.op
         wire = reply.to_bytes() + reply_body
         tentry = self.client_table.get(header.client)
